@@ -1,0 +1,23 @@
+"""cst_captioning_tpu — a TPU-native video-captioning training framework.
+
+A from-scratch JAX/XLA/Flax rebuild of the capabilities of
+``AislingGui/cst_captioning`` (consensus-based sequence training for video
+captioning, Phan et al. 2017, arXiv:1712.09532):
+
+- pre-extracted feature loading (ResNet-152 / C3D / arbitrary modalities) for
+  MSVD and MSR-VTT style datasets,
+- mean-pool and temporal-attention encoders + an LSTM caption decoder as
+  jit-compiled Flax modules,
+- masked / consensus-weighted cross-entropy (XE / WXE) training,
+- a self-critical RL phase (greedy baseline, K Monte-Carlo rollouts, CIDEr-D /
+  BLEU4 consensus rewards, REINFORCE gradients) with the device work fused into
+  single XLA-traced programs,
+- beam-search evaluation with COCO-style metrics (pure Python — no JVM),
+- data-parallel training over ICI via ``jax.sharding.Mesh`` + ``shard_map``.
+
+The reference mount was unreadable during the survey (see SURVEY.md §0); parity
+claims are therefore cited against the CST paper and BASELINE.json rather than
+reference file:line.
+"""
+
+__version__ = "0.1.0"

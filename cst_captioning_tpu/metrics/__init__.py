@@ -1,0 +1,35 @@
+"""Caption metrics: tokenizer, BLEU, ROUGE-L, CIDEr, CIDEr-D, METEOR (approx).
+
+Pure Python/numpy replacements for the reference's vendored ``cider/`` and
+``coco-caption/`` packages (SURVEY.md §2 rows 9-11). No JVM: the PTBTokenizer,
+METEOR and SPICE jars of the reference are replaced by a regex PTB-style
+tokenizer, an exact+stem METEOR variant (clearly labeled approximate), and
+SPICE is out of scope (never used as a reward in the reference's recipes).
+
+CIDEr-D is the RL reward (BASELINE.json configs 3-4) and the model-selection
+metric, so it supports a precomputed corpus document-frequency table exactly
+like the reference's ``CiderD(df='...')``.
+"""
+
+from cst_captioning_tpu.metrics.tokenizer import ptb_tokenize, ptb_tokenize_corpus
+from cst_captioning_tpu.metrics.ngram import ngram_counts, precook
+from cst_captioning_tpu.metrics.bleu import Bleu
+from cst_captioning_tpu.metrics.rouge import RougeL
+from cst_captioning_tpu.metrics.cider import Cider, CiderD, CorpusDF
+from cst_captioning_tpu.metrics.meteor import MeteorApprox
+from cst_captioning_tpu.metrics.scorer import CaptionScorer, score_captions
+
+__all__ = [
+    "ptb_tokenize",
+    "ptb_tokenize_corpus",
+    "ngram_counts",
+    "precook",
+    "Bleu",
+    "RougeL",
+    "Cider",
+    "CiderD",
+    "CorpusDF",
+    "MeteorApprox",
+    "CaptionScorer",
+    "score_captions",
+]

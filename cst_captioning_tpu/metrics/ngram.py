@@ -1,0 +1,34 @@
+"""N-gram counting shared by BLEU and CIDEr (reference: cider/'s precook).
+
+Hot host path during the RL phase: every sampled caption is cooked per step.
+A C++ fast path lives in ``cst_captioning_tpu.ops.native``; this module is the
+always-available pure-Python implementation and the correctness oracle.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+NGram = Tuple[str, ...]
+
+
+def ngram_counts(tokens: Sequence[str], n: int) -> Counter:
+    """Counter of n-grams of a single order ``n``."""
+    return Counter(tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1))
+
+
+def precook(tokens: Sequence[str], max_n: int = 4) -> Counter:
+    """Counter over all n-grams of orders 1..max_n (the cider 'precook')."""
+    counts: Counter = Counter()
+    toks = tuple(tokens)
+    L = len(toks)
+    for n in range(1, max_n + 1):
+        for i in range(L - n + 1):
+            counts[toks[i : i + n]] += 1
+    return counts
+
+
+def cook_refs(refs: Sequence[Sequence[str]], max_n: int = 4) -> List[Counter]:
+    """Precook each reference caption of one video."""
+    return [precook(r, max_n) for r in refs]

@@ -1,0 +1,90 @@
+"""COCOScorer-style wrapper: one call, full metric table.
+
+Replaces the reference's eval wrapper that adapts {video_id: [captions]} dicts
+into the vendored scorers (SURVEY.md §2 row 11). Used both for validation-time
+CIDEr during training and for the final test.py-style metric table.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from cst_captioning_tpu.metrics.bleu import Bleu
+from cst_captioning_tpu.metrics.cider import Cider, CiderD, CorpusDF
+from cst_captioning_tpu.metrics.meteor import MeteorApprox
+from cst_captioning_tpu.metrics.rouge import RougeL
+from cst_captioning_tpu.metrics.tokenizer import ptb_tokenize
+
+
+class CaptionScorer:
+    """Scores {id: [caption strings]} hypotheses against reference pools.
+
+    ``metrics`` selects which scorers run; validation-time callers typically
+    ask only for CIDEr-D (cheap, the model-selection metric), the final eval
+    runs everything (BASELINE.json config 5).
+    """
+
+    def __init__(
+        self,
+        metrics: Sequence[str] = ("Bleu", "ROUGE_L", "METEOR_approx", "CIDEr", "CIDEr-D"),
+        cider_df: "CorpusDF | str" = "corpus",
+        pre_tokenized: bool = False,
+    ):
+        self.metrics = tuple(metrics)
+        self.cider_df = cider_df
+        self.pre_tokenized = pre_tokenized
+
+    def _tok(self, table: Mapping[str, Sequence]) -> Dict[str, List[List[str]]]:
+        if self.pre_tokenized:
+            return {k: [list(c) for c in v] for k, v in table.items()}
+        return {k: [ptb_tokenize(c) for c in v] for k, v in table.items()}
+
+    def score(
+        self,
+        gts: Mapping[str, Sequence],
+        res: Mapping[str, Sequence],
+    ) -> Dict[str, float]:
+        """Returns the metric table; per-id scores via score_with_details."""
+        table, _ = self.score_with_details(gts, res)
+        return table
+
+    def score_with_details(
+        self,
+        gts: Mapping[str, Sequence],
+        res: Mapping[str, Sequence],
+    ):
+        gts_t = self._tok(gts)
+        res_t = self._tok(res)
+        table: Dict[str, float] = {}
+        per_id: Dict[str, np.ndarray] = {}
+        if "Bleu" in self.metrics:
+            corpus, per_order = Bleu(4).compute_score(gts_t, res_t)
+            for n in range(4):
+                table[f"Bleu_{n+1}"] = corpus[n]
+                per_id[f"Bleu_{n+1}"] = per_order[n]
+        if "ROUGE_L" in self.metrics:
+            table["ROUGE_L"], per_id["ROUGE_L"] = RougeL().compute_score(gts_t, res_t)
+        if "METEOR_approx" in self.metrics:
+            table["METEOR_approx"], per_id["METEOR_approx"] = MeteorApprox().compute_score(
+                gts_t, res_t
+            )
+        if "CIDEr" in self.metrics:
+            table["CIDEr"], per_id["CIDEr"] = Cider(df="corpus").compute_score(
+                gts_t, res_t
+            )
+        if "CIDEr-D" in self.metrics:
+            table["CIDEr-D"], per_id["CIDEr-D"] = CiderD(df=self.cider_df).compute_score(
+                gts_t, res_t
+            )
+        return table, per_id
+
+
+def score_captions(
+    gts: Mapping[str, Sequence],
+    res: Mapping[str, Sequence],
+    **kwargs,
+) -> Dict[str, float]:
+    """One-shot convenience wrapper."""
+    return CaptionScorer(**kwargs).score(gts, res)

@@ -1,0 +1,16 @@
+"""Test environment: force JAX onto 8 virtual CPU devices.
+
+Per SURVEY.md §4 item 4: distributed paths (shard_map/pmap grad allreduce,
+per-device RNG) are exercised on fake CPU devices so the suite runs anywhere;
+the real TPU is reserved for bench.py. Must run before the first jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,99 @@
+"""Golden tests for BLEU and ROUGE-L with formula-derived expected values."""
+
+import math
+
+import numpy as np
+
+from cst_captioning_tpu.metrics.bleu import Bleu
+from cst_captioning_tpu.metrics.rouge import RougeL
+
+
+def toks(s):
+    return s.split()
+
+
+def test_bleu_perfect_match():
+    gts = {"v": [toks("a man plays a guitar")]}
+    res = {"v": [toks("a man plays a guitar")]}
+    corpus, per = Bleu(4).compute_score(gts, res)
+    np.testing.assert_allclose(corpus, [1.0, 1.0, 1.0, 1.0], atol=1e-9)
+
+
+def test_bleu_partial_hand_computed():
+    # hyp "the cat" vs ref "the cat sat": p1 = 2/2, p2 = 1/1, p3 undefined (0)
+    # brevity = exp(1 - 3/2) = exp(-0.5)
+    gts = {"v": [toks("the cat sat")]}
+    res = {"v": [toks("the cat")]}
+    corpus, _ = Bleu(4).compute_score(gts, res)
+    bp = math.exp(1.0 - 3.0 / 2.0)
+    np.testing.assert_allclose(corpus[0], bp, atol=1e-9)
+    np.testing.assert_allclose(corpus[1], bp, atol=1e-9)  # sqrt(1*1) = 1
+    assert corpus[2] == 0.0 and corpus[3] == 0.0
+
+
+def test_bleu_clipping():
+    # hyp repeats "the" 4 times; ref has it twice -> clipped p1 = 2/4
+    gts = {"v": [toks("the cat the mat")]}
+    res = {"v": [toks("the the the the")]}
+    corpus, _ = Bleu(1).compute_score(gts, res)
+    np.testing.assert_allclose(corpus[0], 0.5, atol=1e-9)
+
+
+def test_bleu_closest_ref_length():
+    # Two refs lengths 2 and 6; hyp length 2 -> closest is 2 -> bp = 1.
+    gts = {"v": [toks("a b"), toks("a b c d e f")]}
+    res = {"v": [toks("a b")]}
+    corpus, _ = Bleu(1).compute_score(gts, res)
+    np.testing.assert_allclose(corpus[0], 1.0, atol=1e-9)
+
+
+def test_bleu_sentence_smoothing_nonzero():
+    # Per-sentence BLEU-4 of a 4-token partial match must be > 0 via +1 smoothing
+    b = Bleu(4)
+    s = b.sentence_bleu(toks("a man rides horse"), [toks("a man rides a horse")])
+    assert s[3] > 0.0
+    assert (np.diff(s) <= 1e-12).all()  # orders are non-increasing
+
+
+def test_bleu_corpus_pools_counts():
+    # Corpus BLEU pools match/total over segments (not mean of per-sentence).
+    gts = {"a": [toks("x y")], "b": [toks("p q")]}
+    res = {"a": [toks("x y")], "b": [toks("z w")]}
+    corpus, _ = Bleu(1).compute_score(gts, res)
+    np.testing.assert_allclose(corpus[0], 0.5, atol=1e-9)  # 2 of 4 unigrams
+
+
+def test_rouge_perfect_and_disjoint():
+    r = RougeL()
+    assert r.sentence_score(toks("a b c"), [toks("a b c")]) == 1.0
+    assert r.sentence_score(toks("a b c"), [toks("x y z")]) == 0.0
+
+
+def test_rouge_hand_computed():
+    # hyp "the cat" vs ref "the cat sat": lcs=2, p=1, r=2/3, beta=1.2
+    r = RougeL()
+    p, rec, b2 = 1.0, 2.0 / 3.0, 1.2**2
+    expected = (1 + b2) * p * rec / (rec + b2 * p)
+    np.testing.assert_allclose(
+        r.sentence_score(toks("the cat"), [toks("the cat sat")]), expected, atol=1e-9
+    )
+
+
+def test_rouge_max_over_refs():
+    # p from one ref, r from another: coco-caption takes max of each separately
+    r = RougeL()
+    hyp = toks("a b")
+    refs = [toks("a b c d"), toks("a x")]
+    # ref1: lcs 2 -> p=1, rec=0.5 ; ref2: lcs 1 -> p=0.5, rec=0.5
+    p, rec, b2 = 1.0, 0.5, 1.44
+    expected = (1 + b2) * p * rec / (rec + b2 * p)
+    np.testing.assert_allclose(r.sentence_score(hyp, refs), expected, atol=1e-9)
+
+
+def test_lcs_non_contiguous():
+    r = RougeL()
+    # hyp "a x b y c" vs ref "a b c": lcs = 3
+    s = r.sentence_score(toks("a x b y c"), [toks("a b c")])
+    p, rec, b2 = 3.0 / 5.0, 1.0, 1.44
+    expected = (1 + b2) * p * rec / (rec + b2 * p)
+    np.testing.assert_allclose(s, expected, atol=1e-9)

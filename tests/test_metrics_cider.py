@@ -1,0 +1,144 @@
+"""Golden tests for CIDEr / CIDEr-D.
+
+Expected values are derived in-test straight from the paper formulas
+(Vedantam et al. 2015; length penalty exp(-(lh-lr)^2/(2*6^2)) per SURVEY.md §4
+item 1) for small hand-traceable cases — an independent oracle, not a copy of
+the implementation.
+"""
+
+import math
+import pickle
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.metrics.cider import Cider, CiderD, CorpusDF
+
+
+def toks(s):
+    return s.split()
+
+
+def test_identical_hypothesis_scores_10():
+    gts = {"v": [toks("a man plays a guitar")]}
+    res = {"v": [toks("a man plays a guitar")]}
+    for scorer in (Cider(), CiderD()):
+        mean, per = scorer.compute_score(gts, res)
+        assert per.shape == (1,)
+        np.testing.assert_allclose(mean, 10.0, atol=1e-9)
+
+
+def test_disjoint_hypothesis_scores_0():
+    gts = {
+        "v1": [toks("a b c d e")],
+        "v2": [toks("p q r s t")],
+    }
+    res = {"v1": [toks("a b c d e")], "v2": [toks("a b c d e")]}
+    mean, per = CiderD().compute_score(gts, res)
+    np.testing.assert_allclose(per[0], 10.0, atol=1e-9)
+    np.testing.assert_allclose(per[1], 0.0, atol=1e-9)
+    np.testing.assert_allclose(mean, 5.0, atol=1e-9)
+
+
+def test_ciderd_partial_overlap_hand_computed():
+    # Single-doc corpus: ndoc=1 -> log_ndoc = log(max(1, e)) = 1; every ngram
+    # appears in the one doc, so idf = 1 - log(1) = 1 for all ngrams.
+    gts = {"v": [toks("the cat sat")]}
+    res = {"v": [toks("the cat")]}
+    # 1-gram: hyp vec {the:1, cat:1} |.|=sqrt2; ref {the,cat,sat} |.|=sqrt3;
+    #   clipped dot = 2 -> cos = 2/sqrt(6)
+    # 2-gram: hyp {(the,cat)} |.|=1; ref 2 bigrams |.|=sqrt2; dot=1 -> 1/sqrt2
+    # 3,4-gram: hyp has none -> 0
+    # length penalty: exp(-(2-3)^2 / (2*36))
+    expected = (
+        10.0
+        * math.exp(-1.0 / 72.0)
+        * (2.0 / math.sqrt(6.0) + 1.0 / math.sqrt(2.0) + 0.0 + 0.0)
+        / 4.0
+    )
+    _, per = CiderD().compute_score(gts, res)
+    np.testing.assert_allclose(per[0], expected, atol=1e-9)
+
+
+def test_cider_partial_overlap_hand_computed():
+    # Plain CIDEr: same vectors, plain cosine (same dot here since counts<=1),
+    # NO length penalty.
+    gts = {"v": [toks("the cat sat")]}
+    res = {"v": [toks("the cat")]}
+    expected = 10.0 * (2.0 / math.sqrt(6.0) + 1.0 / math.sqrt(2.0)) / 4.0
+    _, per = Cider().compute_score(gts, res)
+    np.testing.assert_allclose(per[0], expected, atol=1e-9)
+
+
+def test_ciderd_length_penalty_sigma6():
+    # Same n-gram content, padded hypothesis: penalty should be exact gaussian.
+    gts = {"v": [toks("a b c d")]}
+    res_exact = {"v": [toks("a b c d")]}
+    res_long = {"v": [toks("a b c d x y")]}  # delta = 2
+    _, per_exact = CiderD().compute_score(gts, res_exact)
+    _, per_long = CiderD().compute_score(gts, res_long)
+    assert per_long[0] < per_exact[0]
+    # the long hyp's 1-gram cosine etc. change too, so only check monotonicity
+    # plus the exact penalty on a pure-length case below:
+    # hyp with same multiset achieved by repetition is hard; instead verify
+    # penalty formula directly on equal-content different-length is covered by
+    # test_ciderd_partial_overlap_hand_computed (delta=-1 term).
+
+
+def test_multiple_refs_average():
+    # Score vs 2 refs = mean of per-ref similarity. With one ref identical and
+    # one disjoint (all idf>0, ndoc=2 -> log_ndoc=1), expect exactly half of
+    # the identical-only score times penalty terms.
+    gts = {"v": [toks("a b c d"), toks("p q r s")], "v2": [toks("z z2 z3 z4")]}
+    res = {"v": [toks("a b c d")], "v2": [toks("z z2 z3 z4")]}
+    _, per = CiderD().compute_score(gts, res)
+    np.testing.assert_allclose(per[0], 5.0, atol=1e-9)
+
+
+def test_precomputed_df_matches_corpus_mode():
+    corpus_gts = {
+        "v1": [toks("a man rides a horse"), toks("a person rides a horse")],
+        "v2": [toks("a cat sits on a mat")],
+    }
+    res = {"v1": [toks("a man rides a horse")], "v2": [toks("a cat sits")]}
+    df = CorpusDF.from_refs([corpus_gts["v1"], corpus_gts["v2"]])
+    m_pre, per_pre = CiderD(df=df).compute_score(corpus_gts, res)
+    m_cor, per_cor = CiderD(df="corpus").compute_score(corpus_gts, res)
+    np.testing.assert_allclose(per_pre, per_cor, atol=1e-12)
+    np.testing.assert_allclose(m_pre, m_cor, atol=1e-12)
+
+
+def test_corpus_df_save_load_roundtrip(tmp_path):
+    df = CorpusDF.from_refs([[toks("a b c")], [toks("b c d")]])
+    p = str(tmp_path / "df.pkl")
+    df.save(p)
+    df2 = CorpusDF.load(p)
+    assert df2.num_docs == 2
+    assert df2.df == df.df
+    assert df2.df[("b", "c")] == 2.0
+
+
+def test_df_counts_documents_not_occurrences():
+    # "a" appears twice in doc 1 but df counts docs containing it.
+    df = CorpusDF.from_refs([[toks("a a b"), toks("a c")], [toks("a d")]])
+    assert df.df[("a",)] == 2.0
+    assert df.df[("b",)] == 1.0
+
+
+def test_reward_vector_ordering_stable():
+    # Distinct refs per doc keep idf > 0 (an ngram in every doc has idf = 0).
+    gts = {f"v{i}": [toks(f"a{i} b{i} c{i} d{i}")] for i in range(5)}
+    res = {
+        f"v{i}": [toks(f"a{i} b{i} c{i} d{i}") if i % 2 == 0 else toks("x y z w")]
+        for i in range(5)
+    }
+    _, per = CiderD().compute_score(gts, res)
+    np.testing.assert_allclose(per, [10.0, 0.0, 10.0, 0.0, 10.0], atol=1e-9)
+
+
+def test_idf_zero_for_ubiquitous_ngrams():
+    # An n-gram appearing in every document has idf = 0 and contributes nothing.
+    gts = {f"v{i}": [toks("a b c d")] for i in range(5)}
+    res = {f"v{i}": [toks("a b c d")] for i in range(5)}
+    mean, _ = CiderD().compute_score(gts, res)
+    np.testing.assert_allclose(mean, 0.0, atol=1e-12)

@@ -1,0 +1,62 @@
+import numpy as np
+
+from cst_captioning_tpu.metrics.meteor import MeteorApprox, _porter_stem
+from cst_captioning_tpu.metrics.scorer import CaptionScorer, score_captions
+
+
+def toks(s):
+    return s.split()
+
+
+def test_stemmer_basics():
+    assert _porter_stem("running") == "run"
+    assert _porter_stem("plays") == "plai"  # y->i after step 1c on "play"
+    assert _porter_stem("played") == "plai"
+    assert _porter_stem("cats") == "cat"
+
+
+def test_meteor_perfect_match_is_high():
+    m = MeteorApprox()
+    s = m.sentence_score(toks("a man rides a horse"), [toks("a man rides a horse")])
+    # perfect alignment: P=R=1 -> F=1, one chunk over 5 matches -> small penalty
+    frag = 1.0 / 5.0
+    expected = 1.0 - 0.6 * frag**3
+    np.testing.assert_allclose(s, expected, atol=1e-9)
+
+
+def test_meteor_stem_stage_matches():
+    m = MeteorApprox()
+    s_exact = m.sentence_score(toks("dog runs"), [toks("dog runs")])
+    s_stem = m.sentence_score(toks("dog running"), [toks("dog runs")])
+    assert 0 < s_stem <= s_exact
+
+
+def test_meteor_disjoint_zero():
+    assert MeteorApprox().sentence_score(toks("a b"), [toks("x y")]) == 0.0
+
+
+def test_scorer_full_table():
+    gts = {
+        "v1": ["a man is playing a guitar", "someone plays guitar"],
+        "v2": ["a cat sits on a mat"],
+    }
+    res = {"v1": ["a man is playing a guitar"], "v2": ["a dog runs"]}
+    table = score_captions(gts, res)
+    for k in ("Bleu_1", "Bleu_4", "ROUGE_L", "METEOR_approx", "CIDEr", "CIDEr-D"):
+        assert k in table, k
+    assert table["Bleu_1"] > 0.5
+    assert 0 <= table["CIDEr-D"] <= 10
+
+
+def test_scorer_pre_tokenized():
+    gts = {"v": [["a", "dog", "runs", "fast"]]}
+    res = {"v": [["a", "dog", "runs", "fast"]]}
+    table = CaptionScorer(metrics=("CIDEr-D",), pre_tokenized=True).score(gts, res)
+    np.testing.assert_allclose(table["CIDEr-D"], 10.0, atol=1e-9)
+
+
+def test_scorer_details_per_id():
+    gts = {"v1": ["a b c d"], "v2": ["a b c d"]}
+    res = {"v1": ["a b c d"], "v2": ["x y z w"]}
+    table, per_id = CaptionScorer(metrics=("CIDEr-D",)).score_with_details(gts, res)
+    np.testing.assert_allclose(per_id["CIDEr-D"], [10.0, 0.0], atol=1e-9)

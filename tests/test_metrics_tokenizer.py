@@ -1,0 +1,39 @@
+from cst_captioning_tpu.metrics.tokenizer import ptb_tokenize, ptb_tokenize_corpus
+
+
+def test_basic_lowercase_and_punct_drop():
+    assert ptb_tokenize("A man is playing a Guitar.") == [
+        "a", "man", "is", "playing", "a", "guitar",
+    ]
+
+
+def test_contractions_split():
+    assert ptb_tokenize("don't") == ["do", "n't"]
+    assert ptb_tokenize("He's running") == ["he", "'s", "running"]
+    assert ptb_tokenize("they'll win, won't they?") == [
+        "they", "'ll", "win", "wo", "n't", "they",
+    ]
+
+
+def test_punctuation_tokens_dropped():
+    assert ptb_tokenize("wait -- no, really...") == ["wait", "no", "really"]
+    assert ptb_tokenize("a (small) dog") == ["a", "small", "dog"]
+
+
+def test_keep_punct_mode():
+    assert ptb_tokenize("a dog.", keep_punct=True) == ["a", "dog", "."]
+
+
+def test_numbers_and_hyphens():
+    # hyphen splits words; the bare hyphen token is punctuation and dropped
+    assert ptb_tokenize("a 2-year-old child") == ["a", "2", "year", "old", "child"]
+
+
+def test_empty_and_whitespace():
+    assert ptb_tokenize("") == []
+    assert ptb_tokenize("   \n  ") == []
+
+
+def test_corpus_tokenize():
+    out = ptb_tokenize_corpus({"v1": ["A dog runs.", "The dog ran!"]})
+    assert out == {"v1": [["a", "dog", "runs"], ["the", "dog", "ran"]]}
