@@ -1,0 +1,39 @@
+"""Typed experiment configuration (replaces the reference's ``opts.py``).
+
+The reference drives everything from a flat argparse namespace of ~50 flags
+plus Makefile recipes (SURVEY.md §2 rows 1, 12).  Here the same surface is a
+set of frozen dataclasses — one per subsystem — composed into an
+:class:`ExperimentConfig`, plus named presets reproducing the five capability
+configs pinned by ``BASELINE.json``.
+"""
+
+from cst_captioning_tpu.config.config import (
+    PAD_ID,
+    BOS_ID,
+    EOS_ID,
+    UNK_ID,
+    ModelConfig,
+    DataConfig,
+    TrainConfig,
+    RLConfig,
+    EvalConfig,
+    MeshConfig,
+    ExperimentConfig,
+)
+from cst_captioning_tpu.config.presets import PRESETS, get_preset
+
+__all__ = [
+    "PAD_ID",
+    "BOS_ID",
+    "EOS_ID",
+    "UNK_ID",
+    "ModelConfig",
+    "DataConfig",
+    "TrainConfig",
+    "RLConfig",
+    "EvalConfig",
+    "MeshConfig",
+    "ExperimentConfig",
+    "PRESETS",
+    "get_preset",
+]

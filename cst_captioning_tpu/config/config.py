@@ -1,0 +1,216 @@
+"""Dataclass configs for model / data / training / RL / eval / mesh.
+
+Design notes (TPU-first):
+
+- Everything that reaches a jitted function is static and hashable, so configs
+  are frozen dataclasses — they can be closed over by ``jax.jit`` without
+  retracing hazards.
+- Token id conventions are fixed framework-wide: PAD=0, BOS=1, EOS=2, UNK=3.
+  PAD=0 lets masks be computed as ``labels != 0`` on device, and keeps padded
+  positions out of every loss/metric without extra bookkeeping.
+- ``modalities`` is an ordered mapping name -> raw feature dim (e.g.
+  ``{"resnet": 2048, "c3d": 500}``), mirroring the reference's multi-h5
+  feature list but with the dims carried in config so model init needs no
+  data peek.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+UNK_ID = 3
+NUM_SPECIAL_TOKENS = 4
+
+
+def _freeze_modalities(m: Mapping[str, int]) -> tuple[tuple[str, int], ...]:
+    return tuple((str(k), int(v)) for k, v in m.items())
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Caption model shape (reference ``model.py::CaptionModel`` capability)."""
+
+    vocab_size: int = 512
+    # ordered (name, raw_dim) pairs; tuple-of-tuples so the config is hashable.
+    modalities: tuple[tuple[str, int], ...] = (("resnet", 2048),)
+    d_embed: int = 512          # word embedding + per-modality frame embedding dim
+    d_hidden: int = 512         # LSTM hidden size
+    encoder: str = "meanpool"   # "meanpool" | "temporal_attention"
+    d_att: int = 256            # additive-attention projection dim
+    num_layers: int = 1         # LSTM layers (reference uses 1)
+    dropout: float = 0.5
+    max_len: int = 30           # max caption length incl. EOS
+    max_frames: int = 60        # frame-axis padding length
+    dtype: str = "bfloat16"     # compute dtype for MXU-friendly matmuls
+    param_dtype: str = "float32"
+
+    def __post_init__(self):
+        if isinstance(self.modalities, Mapping):
+            object.__setattr__(self, "modalities", _freeze_modalities(self.modalities))
+        else:
+            object.__setattr__(
+                self, "modalities", tuple((str(k), int(v)) for k, v in self.modalities)
+            )
+        if self.encoder not in ("meanpool", "temporal_attention"):
+            raise ValueError(f"unknown encoder: {self.encoder!r}")
+
+    @property
+    def modality_names(self) -> tuple[str, ...]:
+        return tuple(name for name, _ in self.modalities)
+
+    @property
+    def modality_dims(self) -> dict[str, int]:
+        return dict(self.modalities)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Dataset wiring (reference ``dataloader.py`` capability)."""
+
+    dataset: str = "synthetic"          # "msvd" | "msrvtt" | "synthetic"
+    feature_files: tuple[tuple[str, str], ...] = ()  # (modality, h5 path)
+    info_json: str = ""                 # vocab + splits + tokenized captions
+    consensus_weights: str = ""         # WXE per-caption weights (npz), optional
+    cider_df: str = ""                  # precomputed CIDEr-D document freqs, optional
+    batch_size: int = 64                # global batch (split across data axis)
+    seq_per_vid: int = 1                # caption rows sampled per video (XE)
+    shuffle_seed: int = 0
+    prefetch: int = 2                   # device prefetch depth
+
+    def __post_init__(self):
+        if isinstance(self.feature_files, Mapping):
+            object.__setattr__(
+                self,
+                "feature_files",
+                tuple((str(k), str(v)) for k, v in self.feature_files.items()),
+            )
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Optimization loop (reference ``train.py`` capability)."""
+
+    optimizer: str = "adam"
+    lr: float = 1e-4
+    lr_decay: float = 0.5               # multiplicative decay factor
+    lr_decay_every: int = 3             # epochs between decays (0 = constant)
+    grad_clip: float = 5.0              # global-norm clip
+    epochs: int = 30
+    seed: int = 1234
+    weight_decay: float = 0.0
+    label_smoothing: float = 0.0
+    loss: str = "xe"                    # "xe" | "wxe"
+    log_every: int = 50
+    eval_every_epochs: int = 1
+    ckpt_dir: str = "checkpoints"
+    resume: str = ""                    # "", "auto", or explicit ckpt path
+
+
+@dataclass(frozen=True)
+class RLConfig:
+    """CST / self-critical phase (reference RL loop, SURVEY.md §3.2)."""
+
+    enabled: bool = False
+    num_rollouts: int = 5               # K Monte-Carlo samples per clip
+    baseline: str = "greedy"            # "greedy" (SCST) | "scb" (self-consensus) | "none"
+    reward_cider_weight: float = 1.0
+    reward_bleu4_weight: float = 0.0
+    temperature: float = 1.0
+    lr: float = 2e-5                    # RL phase LR (fresh optimizer on handoff)
+    epochs: int = 20
+    init_from: str = ""                 # XE checkpoint to start from
+
+
+@dataclass(frozen=True)
+class EvalConfig:
+    """Evaluation (reference ``test.py`` capability)."""
+
+    beam_size: int = 5
+    max_len: int = 30
+    length_penalty: float = 0.0         # 0 = pure sum-logprob (reference behavior)
+    split: str = "test"
+    metrics: tuple[str, ...] = ("Bleu_4", "METEOR", "ROUGE_L", "CIDEr", "CIDEr-D")
+    results_json: str = ""
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh (replaces torch.nn.DataParallel / NCCL, SURVEY.md §2).
+
+    Axis names are chosen so a future multi-host ('dcn', 'data') hierarchy can
+    be layered in without changing call sites.
+    """
+
+    data_axis: str = "data"
+    num_devices: int = 0                # 0 = all visible devices
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    name: str = "experiment"
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    rl: RLConfig = field(default_factory=RLConfig)
+    eval: EvalConfig = field(default_factory=EvalConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    # ---- serialization ----------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, default=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ExperimentConfig":
+        def build(tp, val):
+            if val is None:
+                return tp()
+            fields = {f.name: f for f in dataclasses.fields(tp)}
+            kwargs = {}
+            for k, v in val.items():
+                if k not in fields:
+                    raise KeyError(f"{tp.__name__}: unknown field {k!r}")
+                if isinstance(v, list):
+                    v = tuple(tuple(x) if isinstance(x, list) else x for x in v)
+                kwargs[k] = v
+            return tp(**kwargs)
+
+        return cls(
+            name=d.get("name", "experiment"),
+            model=build(ModelConfig, d.get("model")),
+            data=build(DataConfig, d.get("data")),
+            train=build(TrainConfig, d.get("train")),
+            rl=build(RLConfig, d.get("rl")),
+            eval=build(EvalConfig, d.get("eval")),
+            mesh=build(MeshConfig, d.get("mesh")),
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "ExperimentConfig":
+        return cls.from_dict(json.loads(s))
+
+    def override(self, **dotted: Any) -> "ExperimentConfig":
+        """Apply ``section__field=value`` overrides (CLI escape hatch).
+
+        ``cfg.override(model__d_hidden=1024, rl__enabled=True)``
+        """
+        out = self
+        for key, value in dotted.items():
+            section, _, fname = key.partition("__")
+            if not fname:
+                out = dataclasses.replace(out, **{section: value})
+                continue
+            sub = getattr(out, section)
+            out = dataclasses.replace(
+                out, **{section: dataclasses.replace(sub, **{fname: value})}
+            )
+        return out

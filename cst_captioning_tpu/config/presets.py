@@ -1,0 +1,130 @@
+"""Named presets — the Makefile-equivalent experiment recipes.
+
+The five presets reproduce, one-for-one, the capability configs recorded by the
+driver in ``BASELINE.json`` (the acceptance surface of the rebuild; the
+reference expressed these as Makefile targets over ``opts.py`` flags,
+SURVEY.md §2 rows 1/12):
+
+1. ``msvd_xe_meanpool``      — MSVD, ResNet-152 mean-pool, 1-layer LSTM, XE.
+2. ``msrvtt_xe_attention``   — MSR-VTT, ResNet-152 + C3D, temporal attention, XE.
+3. ``msrvtt_scst``           — MSR-VTT CST fine-tune: greedy baseline + CIDEr-D (SCST).
+4. ``msrvtt_cst_consensus``  — MSR-VTT weighted-consensus reward (CIDEr-D + BLEU4),
+                               5 Monte-Carlo rollouts, self-consensus (SCB) baseline.
+5. ``msrvtt_eval_beam5``     — MSR-VTT eval: beam search (beam=5) + COCO metrics.
+
+Paper CST variant names map onto presets as: XE -> 1/2; CST_GT_None/SCST -> 3;
+CST_MS_SCB -> 4 (with ``rl.baseline="scb"``); WXE is preset 2 with
+``train.loss="wxe"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from cst_captioning_tpu.config.config import (
+    DataConfig,
+    EvalConfig,
+    ExperimentConfig,
+    ModelConfig,
+    RLConfig,
+    TrainConfig,
+)
+
+# MSR-VTT-scale vocab (reference builds ~8-11k word vocab after thresholding);
+# synthetic/test runs override this downward.
+_MSVD_VOCAB = 4000
+_MSRVTT_VOCAB = 9000
+
+
+def _msvd_xe_meanpool() -> ExperimentConfig:
+    return ExperimentConfig(
+        name="msvd_xe_meanpool",
+        model=ModelConfig(
+            vocab_size=_MSVD_VOCAB,
+            modalities=(("resnet", 2048),),
+            encoder="meanpool",
+            d_embed=512,
+            d_hidden=512,
+            max_len=30,
+            max_frames=28,
+        ),
+        data=DataConfig(dataset="msvd", batch_size=64),
+        train=TrainConfig(loss="xe", lr=1e-4, epochs=50),
+    )
+
+
+def _msrvtt_xe_attention() -> ExperimentConfig:
+    return ExperimentConfig(
+        name="msrvtt_xe_attention",
+        model=ModelConfig(
+            vocab_size=_MSRVTT_VOCAB,
+            modalities=(("resnet", 2048), ("c3d", 500)),
+            encoder="temporal_attention",
+            d_embed=512,
+            d_hidden=512,
+            d_att=256,
+            max_len=30,
+            max_frames=28,
+        ),
+        data=DataConfig(dataset="msrvtt", batch_size=64),
+        train=TrainConfig(loss="xe", lr=1e-4, epochs=50),
+    )
+
+
+def _msrvtt_scst() -> ExperimentConfig:
+    base = _msrvtt_xe_attention()
+    return dataclasses.replace(
+        base,
+        name="msrvtt_scst",
+        rl=RLConfig(
+            enabled=True,
+            num_rollouts=1,
+            baseline="greedy",
+            reward_cider_weight=1.0,
+            reward_bleu4_weight=0.0,
+            lr=2e-5,
+        ),
+    )
+
+
+def _msrvtt_cst_consensus() -> ExperimentConfig:
+    base = _msrvtt_xe_attention()
+    return dataclasses.replace(
+        base,
+        name="msrvtt_cst_consensus",
+        rl=RLConfig(
+            enabled=True,
+            num_rollouts=5,
+            baseline="scb",
+            reward_cider_weight=1.0,
+            reward_bleu4_weight=0.5,
+            lr=2e-5,
+        ),
+    )
+
+
+def _msrvtt_eval_beam5() -> ExperimentConfig:
+    base = _msrvtt_xe_attention()
+    return dataclasses.replace(
+        base,
+        name="msrvtt_eval_beam5",
+        eval=EvalConfig(beam_size=5, max_len=30, split="test"),
+    )
+
+
+PRESETS = {
+    "msvd_xe_meanpool": _msvd_xe_meanpool,
+    "msrvtt_xe_attention": _msrvtt_xe_attention,
+    "msrvtt_scst": _msrvtt_scst,
+    "msrvtt_cst_consensus": _msrvtt_cst_consensus,
+    "msrvtt_eval_beam5": _msrvtt_eval_beam5,
+}
+
+
+def get_preset(name: str) -> ExperimentConfig:
+    try:
+        return PRESETS[name]()
+    except KeyError:
+        raise KeyError(
+            f"unknown preset {name!r}; available: {sorted(PRESETS)}"
+        ) from None

@@ -1,0 +1,33 @@
+"""Data layer: vocab, feature stores, batching, prefetch, preprocessing.
+
+Replaces the reference's ``dataloader.py`` + preprocessing scripts
+(SURVEY.md §2 rows 2-3) with a TPU-first pipeline: h5 multi-modality feature
+reading on the host, fixed-shape padded batches (static shapes for XLA), and a
+background prefetcher that lands per-device shards in HBM ahead of the step.
+"""
+
+from cst_captioning_tpu.data.vocab import Vocab
+from cst_captioning_tpu.data.dataset import CaptionDataset, VideoRecord
+from cst_captioning_tpu.data.batcher import Batch, Batcher
+from cst_captioning_tpu.data.synthetic import make_synthetic_dataset
+from cst_captioning_tpu.data.prefetch import prefetch_to_device
+from cst_captioning_tpu.data.preprocess import (
+    build_vocab,
+    tokenize_captions,
+    compute_consensus_weights,
+    compute_cider_df,
+)
+
+__all__ = [
+    "Vocab",
+    "CaptionDataset",
+    "VideoRecord",
+    "Batch",
+    "Batcher",
+    "make_synthetic_dataset",
+    "prefetch_to_device",
+    "build_vocab",
+    "tokenize_captions",
+    "compute_consensus_weights",
+    "compute_cider_df",
+]

@@ -1,0 +1,152 @@
+"""Fixed-shape batch construction.
+
+The reference collates variable-length captions by padding to the batch max
+(SURVEY.md §3.4). On TPU that would retrace/recompile per batch shape, so here
+EVERY batch is padded to the static ``(batch_size, max_len)`` /
+``(batch_size, max_frames, dim)`` envelope — XLA compiles each program once.
+
+Two iteration modes:
+
+- ``mode="caption"`` (XE phase): one row per (video, caption) pair,
+  ``seq_per_vid`` captions sampled per video per epoch.
+- ``mode="video"`` (RL decode / eval): one row per video; caption slots carry
+  an arbitrary GT row (unused by decoding).
+
+Short final batches are wrapped (circular) with a ``valid`` row mask so shapes
+stay static while eval stays exact.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from cst_captioning_tpu.config.config import EOS_ID, PAD_ID
+from cst_captioning_tpu.data.dataset import CaptionDataset
+
+
+@dataclass
+class Batch:
+    feats: dict[str, np.ndarray]       # name -> [B, F, D] float32
+    feat_masks: dict[str, np.ndarray]  # name -> [B, F]    float32
+    labels: np.ndarray                 # [B, T] int32: word ids + EOS, then PAD
+    mask: np.ndarray                   # [B, T] float32: 1 on real tokens incl. EOS
+    weights: np.ndarray                # [B]    float32: WXE consensus weights
+    valid: np.ndarray                  # [B]    bool: False on wrap-padding rows
+    video_ids: list[str]
+
+    @property
+    def size(self) -> int:
+        return int(self.valid.sum())
+
+
+def encode_label_row(caption_ids: list[int], max_len: int) -> tuple[np.ndarray, np.ndarray]:
+    """ids (no specials) -> (labels [T], mask [T]) with EOS and PAD=0 padding."""
+    row = np.full((max_len,), PAD_ID, dtype=np.int32)
+    m = np.zeros((max_len,), dtype=np.float32)
+    toks = caption_ids[: max_len - 1]          # reserve one slot for EOS
+    row[: len(toks)] = toks
+    row[len(toks)] = EOS_ID
+    m[: len(toks) + 1] = 1.0
+    return row, m
+
+
+class Batcher:
+    def __init__(
+        self,
+        dataset: CaptionDataset,
+        batch_size: int,
+        max_len: int,
+        mode: str = "caption",
+        seq_per_vid: int = 1,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if mode not in ("caption", "video"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.ds = dataset
+        self.batch_size = batch_size
+        self.max_len = max_len
+        self.mode = mode
+        self.seq_per_vid = seq_per_vid
+        self.rng = np.random.default_rng(seed)
+        self.drop_last = drop_last
+
+    def _items(self, shuffle: bool) -> list[tuple[int, int]]:
+        """List of (record_idx, caption_idx) rows for one epoch."""
+        items: list[tuple[int, int]] = []
+        for ri, rec in enumerate(self.ds.records):
+            ncap = max(len(rec.caption_ids), 1)
+            if self.mode == "video":
+                items.append((ri, 0))
+            else:
+                k = min(self.seq_per_vid, ncap)
+                caps = self.rng.choice(ncap, size=k, replace=False) if shuffle else range(k)
+                items.extend((ri, int(ci)) for ci in caps)
+        if shuffle:
+            self.rng.shuffle(items)
+        return items
+
+    def __iter__(self):
+        return self.epoch(shuffle=self.mode == "caption")
+
+    def epoch(self, shuffle: bool = True):
+        items = self._items(shuffle)
+        bs = self.batch_size
+        n = len(items)
+        for start in range(0, n, bs):
+            chunk = items[start : start + bs]
+            if len(chunk) < bs:
+                if self.drop_last:
+                    return
+                pad = [chunk[i % len(chunk)] for i in range(bs - len(chunk))]
+                valid = np.array([True] * len(chunk) + [False] * len(pad))
+                chunk = chunk + pad
+            else:
+                valid = np.ones((bs,), dtype=bool)
+            yield self._collate(chunk, valid)
+
+    def _collate(self, items: list[tuple[int, int]], valid: np.ndarray) -> Batch:
+        bs, T = self.batch_size, self.max_len
+        names = list(self.ds.stores)
+        feats = {
+            n: np.zeros((bs, self.ds.max_frames, self.ds.stores[n].dim), np.float32)
+            for n in names
+        }
+        fmasks = {n: np.zeros((bs, self.ds.max_frames), np.float32) for n in names}
+        labels = np.full((bs, T), PAD_ID, dtype=np.int32)
+        mask = np.zeros((bs, T), dtype=np.float32)
+        weights = np.ones((bs,), dtype=np.float32)
+        video_ids = []
+        # memoize per-video features within the batch: seq_per_vid>1 and
+        # wrap-padding repeat videos, and h5 reads are the host hot path
+        feat_cache: dict[str, dict] = {}
+        for b, (ri, ci) in enumerate(items):
+            rec = self.ds.records[ri]
+            video_ids.append(rec.video_id)
+            if rec.video_id not in feat_cache:
+                feat_cache[rec.video_id] = self.ds.features_for(rec.video_id)
+            for n, (f, fm) in feat_cache[rec.video_id].items():
+                feats[n][b] = f
+                fmasks[n][b] = fm
+            if rec.caption_ids:
+                ci = min(ci, len(rec.caption_ids) - 1)
+                labels[b], mask[b] = encode_label_row(rec.caption_ids[ci], T)
+                if rec.weights:
+                    weights[b] = rec.weights[ci]
+        return Batch(
+            feats=feats,
+            feat_masks=fmasks,
+            labels=labels,
+            mask=mask,
+            weights=weights,
+            valid=valid,
+            video_ids=video_ids,
+        )
+
+    def num_batches(self) -> int:
+        n = len(self._items(shuffle=False))
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)

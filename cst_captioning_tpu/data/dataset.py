@@ -1,0 +1,158 @@
+"""Caption dataset: h5 multi-modality features + json metadata.
+
+Mirrors the reference's on-disk contract (SURVEY.md §3.4) with a cleaner
+schema we own (the reference's exact h5 key names were unverifiable, §0):
+
+- one h5 file per modality; dataset key = video id; value = [n_frames, dim]
+  float array (mean-pooled modalities may have n_frames == 1),
+- one ``info.json``: vocab table, per-video split + tokenized captions
+  (both as id lists and raw strings, the latter feeding reward/eval pools),
+- optional ``consensus_weights`` npz (WXE) and CIDEr df pickle-free npz (RL),
+  produced by :mod:`cst_captioning_tpu.data.preprocess`.
+
+All feature arrays are padded/truncated to ``max_frames`` on read and carry a
+frame-validity mask, so every batch has static shapes for XLA.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from cst_captioning_tpu.data.vocab import Vocab
+
+try:
+    import h5py
+except ImportError:  # pragma: no cover - h5py is baked into the image
+    h5py = None
+
+
+@dataclass
+class VideoRecord:
+    video_id: str
+    split: str
+    # tokenized captions as id lists (no BOS/EOS; added at batch time)
+    caption_ids: list[list[int]] = field(default_factory=list)
+    # raw tokenized caption strings (reward/eval reference pools)
+    captions: list[str] = field(default_factory=list)
+    # per-caption consensus weights (WXE), parallel to caption_ids
+    weights: list[float] = field(default_factory=list)
+
+
+class FeatureStore:
+    """Lazy h5-backed frame features for one modality, padded to max_frames."""
+
+    def __init__(self, path: str, max_frames: int, dim: int | None = None):
+        if h5py is None:
+            raise RuntimeError("h5py unavailable")
+        self.path = path
+        self.max_frames = max_frames
+        self._h5 = h5py.File(path, "r")
+        first = next(iter(self._h5))
+        arr = self._h5[first]
+        self.dim = int(dim if dim is not None else arr.shape[-1])
+
+    def keys(self):
+        return list(self._h5.keys())
+
+    def get(self, video_id: str) -> tuple[np.ndarray, np.ndarray]:
+        """-> (feats [max_frames, dim] f32, mask [max_frames] f32)."""
+        raw = np.asarray(self._h5[video_id], dtype=np.float32)
+        if raw.ndim == 1:
+            raw = raw[None, :]
+        n = min(raw.shape[0], self.max_frames)
+        if raw.shape[0] > self.max_frames:
+            # uniform temporal subsample instead of truncation: keeps coverage
+            # of the whole clip when frame counts exceed the budget.
+            idx = np.linspace(0, raw.shape[0] - 1, self.max_frames).round().astype(int)
+            raw = raw[idx]
+            n = self.max_frames
+        feats = np.zeros((self.max_frames, self.dim), dtype=np.float32)
+        feats[:n] = raw[:n]
+        mask = np.zeros((self.max_frames,), dtype=np.float32)
+        mask[:n] = 1.0
+        return feats, mask
+
+    def close(self):
+        self._h5.close()
+
+
+class CaptionDataset:
+    """Videos of one split with their features, captions, and reward pools."""
+
+    def __init__(
+        self,
+        info_json: str,
+        feature_files: dict[str, str],
+        split: str,
+        max_frames: int = 60,
+        consensus_weights: str = "",
+    ):
+        with open(info_json) as f:
+            info = json.load(f)
+        self.vocab = Vocab(info["vocab"])
+        self.split = split
+        self.records: list[VideoRecord] = []
+        for v in info["videos"]:
+            if v["split"] != split:
+                continue
+            if not v["caption_ids"]:
+                raise ValueError(
+                    f"video {v['id']!r} has no captions; every record needs at "
+                    "least one (empty rows would produce all-PAD label rows)"
+                )
+            self.records.append(
+                VideoRecord(
+                    video_id=v["id"],
+                    split=v["split"],
+                    caption_ids=[list(map(int, c)) for c in v["caption_ids"]],
+                    captions=[str(c) for c in v["captions"]],
+                )
+            )
+        if not self.records:
+            raise ValueError(f"no videos for split {split!r} in {info_json}")
+        self.stores = {
+            name: FeatureStore(path, max_frames=max_frames)
+            for name, path in feature_files.items()
+        }
+        self.max_frames = max_frames
+        if consensus_weights:
+            if not os.path.exists(consensus_weights):
+                raise FileNotFoundError(
+                    f"consensus_weights file not found: {consensus_weights}"
+                )
+            self._load_weights(consensus_weights)
+        else:
+            for r in self.records:
+                r.weights = [1.0] * len(r.caption_ids)
+
+    def _load_weights(self, path: str):
+        """npz: one array per video id, parallel to its caption list."""
+        data = np.load(path)
+        for r in self.records:
+            if r.video_id in data:
+                w = np.asarray(data[r.video_id], dtype=np.float32)
+                if len(w) != len(r.caption_ids):
+                    raise ValueError(
+                        f"weights/captions length mismatch for {r.video_id}"
+                    )
+                r.weights = [float(x) for x in w]
+            else:
+                r.weights = [1.0] * len(r.caption_ids)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def features_for(self, video_id: str) -> dict[str, tuple[np.ndarray, np.ndarray]]:
+        return {name: store.get(video_id) for name, store in self.stores.items()}
+
+    def gts_pool(self) -> dict[str, list[str]]:
+        """video_id -> list of tokenized GT caption strings (reward/eval refs)."""
+        return {r.video_id: list(r.captions) for r in self.records}
+
+    def close(self):
+        for s in self.stores.values():
+            s.close()

@@ -1,0 +1,83 @@
+"""Background host->device prefetch.
+
+The reference moves each batch with ``.cuda()`` inline in the hot loop
+(SURVEY.md §3.1); here a daemon thread stages upcoming batches into HBM with
+``jax.device_put`` while the current step runs, hiding PCIe/host latency —
+the flax ``prefetch_to_device`` pattern, generalized to our Batch pytrees and
+to explicit shardings (so prefetch lands per-device shards directly when a
+Mesh is in play).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+
+
+def prefetch_to_device(
+    it: Iterable[Any],
+    size: int = 2,
+    sharding: Any | None = None,
+    transform: Callable[[Any], Any] | None = None,
+) -> Iterator[Any]:
+    """Iterate ``it``, staging ``size`` elements ahead onto device.
+
+    ``transform`` runs on the host thread before the transfer (e.g. Batch ->
+    device-ready pytree); ``sharding`` is forwarded to ``jax.device_put`` so
+    multi-device layouts are materialized without a separate reshard.
+    """
+    if size < 1:
+        for x in it:
+            x = transform(x) if transform is not None else x
+            yield jax.device_put(x, sharding) if sharding is not None else jax.device_put(x)
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=size)
+    _END = object()
+    err: list[BaseException] = []
+    stop = threading.Event()
+
+    def _put(x) -> bool:
+        """put that gives up when the consumer abandoned the generator."""
+        while not stop.is_set():
+            try:
+                q.put(x, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for x in it:
+                x = transform(x) if transform is not None else x
+                x = jax.device_put(x, sharding) if sharding is not None else jax.device_put(x)
+                if not _put(x):
+                    return  # consumer gone: drop staged work, free buffers
+        except BaseException as e:  # propagate into the consumer
+            err.append(e)
+        finally:
+            _put(_END)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            x = q.get()
+            if x is _END:
+                if err:
+                    raise err[0]
+                return
+            yield x
+    finally:
+        # consumer broke out early (or errored): unblock and retire the worker
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=2.0)

@@ -1,0 +1,115 @@
+"""Preprocessing: vocab build, tokenization, consensus weights, CIDEr df.
+
+Rebuilds the reference's standalone preprocessing scripts (SURVEY.md §2 row 3):
+
+- :func:`build_vocab` — frequency-thresholded word table (rare words -> <unk>),
+- :func:`tokenize_captions` — PTB-style tokenization via our metrics tokenizer,
+- :func:`compute_consensus_weights` — per-caption consensus score: CIDEr-D of
+  each GT caption against the OTHER GTs of the same video; these become the
+  WXE loss weights (CST paper §3.2),
+- :func:`compute_cider_df` — train-split document frequencies for the RL
+  reward's CiderD (precomputed once, like the reference's df pickle),
+- :func:`build_info_json` — assembles the dataset's ``info.json``.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from cst_captioning_tpu.data.vocab import Vocab
+from cst_captioning_tpu.metrics.cider import CiderD, CorpusDF
+from cst_captioning_tpu.metrics.tokenizer import ptb_tokenize_corpus
+
+
+def tokenize_captions(raw: Mapping[str, Sequence[str]]) -> dict[str, list[list[str]]]:
+    """{video_id: [raw sentence, ...]} -> {video_id: [[token, ...], ...]}.
+
+    Delegates to the metrics tokenizer so preprocessing (vocab, df, consensus
+    weights) and reward/eval scoring can never diverge on tokenization.
+    """
+    return ptb_tokenize_corpus(dict(raw))
+
+
+def build_vocab(
+    tokenized: Mapping[str, Sequence[Sequence[str]]],
+    min_count: int = 1,
+) -> Vocab:
+    """Frequency-thresholded vocab over all captions (rare words become <unk>)."""
+    counts: Counter = Counter()
+    for caps in tokenized.values():
+        for toks in caps:
+            counts.update(toks)
+    words = sorted(w for w, c in counts.items() if c >= min_count)
+    return Vocab.from_corpus_words(words)
+
+
+def compute_consensus_weights(
+    tokenized: Mapping[str, Sequence[Sequence[str]]],
+    df: CorpusDF | None = None,
+    normalize: str = "mean1",
+) -> dict[str, np.ndarray]:
+    """Per-caption consensus = CIDEr-D of the caption vs its sibling GTs.
+
+    ``normalize="mean1"`` rescales each video's weights to mean 1 so WXE keeps
+    the same overall loss scale as XE; ``"none"`` keeps raw CIDEr-D/10 scores.
+
+    When ``df`` is None a corpus df (one document per video) is built over all
+    of ``tokenized`` — scoring leave-one-out pools with df computed from the
+    pools themselves would drive the idf of every shared n-gram to zero.
+    """
+    if df is None:
+        df = compute_cider_df(tokenized)
+    scorer = CiderD(df=df)
+    out: dict[str, np.ndarray] = {}
+    for vid, caps in tokenized.items():
+        caps = [list(c) for c in caps]
+        if len(caps) < 2:
+            out[vid] = np.ones((len(caps),), dtype=np.float32)
+            continue
+        gts, res = {}, {}
+        for i, cap in enumerate(caps):
+            key = f"{vid}#{i}"
+            res[key] = [cap]
+            gts[key] = [c for j, c in enumerate(caps) if j != i]
+        _, per_cap = scorer.compute_score(gts, res)
+        w = np.asarray(per_cap, dtype=np.float32) / 10.0
+        if normalize == "mean1":
+            mean = float(w.mean())
+            w = w / mean if mean > 1e-8 else np.ones_like(w)
+        out[vid] = w
+    return out
+
+
+def compute_cider_df(
+    tokenized: Mapping[str, Sequence[Sequence[str]]], max_n: int = 4
+) -> CorpusDF:
+    """Train-split document frequencies (one document = one video's GT pool)."""
+    return CorpusDF.from_refs(list(tokenized.values()), max_n=max_n)
+
+
+def build_info_json(
+    out_path: str,
+    raw_captions: Mapping[str, Sequence[str]],
+    splits: Mapping[str, str],
+    min_count: int = 1,
+) -> Vocab:
+    """Tokenize + build vocab + write the dataset info.json; returns the vocab."""
+    tokenized = tokenize_captions(raw_captions)
+    vocab = build_vocab(tokenized, min_count=min_count)
+    videos = []
+    for vid, caps in tokenized.items():
+        videos.append(
+            {
+                "id": vid,
+                "split": splits.get(vid, "train"),
+                "captions": [" ".join(t) for t in caps],
+                "caption_ids": [vocab.encode(t) for t in caps],
+            }
+        )
+    with open(out_path, "w") as f:
+        json.dump({"vocab": vocab.words, "videos": videos}, f)
+    return vocab

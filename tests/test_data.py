@@ -1,0 +1,183 @@
+"""Data layer tests: vocab, synthetic fixtures, dataset, batcher, preprocess."""
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID, UNK_ID
+from cst_captioning_tpu.data import (
+    Batcher,
+    CaptionDataset,
+    Vocab,
+    build_vocab,
+    compute_cider_df,
+    compute_consensus_weights,
+    make_synthetic_dataset,
+    tokenize_captions,
+)
+
+
+@pytest.fixture(scope="module")
+def synth(tmp_path_factory):
+    out = tmp_path_factory.mktemp("synth")
+    paths = make_synthetic_dataset(
+        str(out),
+        num_videos=16,
+        modalities={"resnet": 32, "c3d": 16},
+        max_frames=6,
+        seed=7,
+    )
+    return paths
+
+
+def test_vocab_roundtrip():
+    v = Vocab.from_corpus_words(["cat", "dog", "runs"])
+    assert len(v) == 7
+    ids = v.encode(["dog", "runs", "zebra"])
+    assert ids == [v.encode(["dog"])[0], v.encode(["runs"])[0], UNK_ID]
+    assert v.decode([BOS_ID] + v.encode(["cat", "runs"]) + [EOS_ID, PAD_ID]) == "cat runs"
+    v2 = Vocab.from_json(v.to_json())
+    assert v2.words == v.words
+
+
+def test_synthetic_dataset_loads(synth):
+    ds = CaptionDataset(
+        synth["info_json"],
+        {"resnet": synth["resnet"], "c3d": synth["c3d"]},
+        split="train",
+        max_frames=6,
+    )
+    assert len(ds) == 12  # 16 * 0.75
+    feats = ds.features_for(ds.records[0].video_id)
+    f, m = feats["resnet"]
+    assert f.shape == (6, 32) and m.shape == (6,)
+    assert m.sum() >= 2
+    # masked-out frames are zero
+    assert np.all(f[m == 0] == 0)
+    pool = ds.gts_pool()
+    assert all(len(caps) == 5 for caps in pool.values())
+    ds.close()
+
+
+def test_batcher_caption_mode_shapes(synth):
+    ds = CaptionDataset(synth["info_json"], {"resnet": synth["resnet"]}, "train", 6)
+    b = Batcher(ds, batch_size=5, max_len=12, mode="caption", seq_per_vid=2, seed=1)
+    batches = list(b.epoch())
+    assert len(batches) == b.num_batches()
+    for batch in batches:
+        assert batch.labels.shape == (5, 12)
+        assert batch.mask.shape == (5, 12)
+        assert batch.feats["resnet"].shape == (5, 6, 32)
+        # every valid row ends with EOS at the last masked position
+        for r in range(5):
+            n = int(batch.mask[r].sum())
+            assert n >= 1
+            assert batch.labels[r, n - 1] == EOS_ID
+            assert np.all(batch.labels[r, n:] == PAD_ID)
+    # wrap-padding marks invalid rows
+    total_valid = sum(b2.size for b2 in batches)
+    assert total_valid == 12 * 2
+    ds.close()
+
+
+def test_batcher_video_mode_unique_ids(synth):
+    ds = CaptionDataset(synth["info_json"], {"resnet": synth["resnet"]}, "test", 6)
+    b = Batcher(ds, batch_size=3, max_len=12, mode="video")
+    seen = []
+    for batch in b.epoch(shuffle=False):
+        seen.extend(v for v, ok in zip(batch.video_ids, batch.valid) if ok)
+    assert sorted(seen) == sorted(r.video_id for r in ds.records)
+    ds.close()
+
+
+def test_preprocess_consensus_weights():
+    raw = {
+        "v1": ["a cat runs fast", "a cat runs", "a dog sleeps here now"],
+        "v2": ["the sun is bright", "the sun is very bright"],
+    }
+    tok = tokenize_captions(raw)
+    v = build_vocab(tok, min_count=1)
+    assert "<unk>" in v.words and "cat" in v.words
+    w = compute_consensus_weights(tok)
+    assert set(w) == {"v1", "v2"}
+    # the outlier caption ("a dog sleeps...") gets the lowest consensus weight
+    assert np.argmin(w["v1"]) == 2
+    # mean-1 normalization per video
+    for arr in w.values():
+        assert arr.mean() == pytest.approx(1.0, abs=1e-5)
+    df = compute_cider_df(tok)
+    assert df.num_docs == 2
+    assert df.df  # non-empty
+
+
+def test_prefetch_to_device(synth):
+    import jax
+
+    from cst_captioning_tpu.data.prefetch import prefetch_to_device
+
+    ds = CaptionDataset(synth["info_json"], {"resnet": synth["resnet"]}, "train", 6)
+    b = Batcher(ds, batch_size=4, max_len=10, mode="caption")
+    out = list(
+        prefetch_to_device(
+            b.epoch(shuffle=False),
+            size=2,
+            transform=lambda batch: {"labels": batch.labels, "mask": batch.mask},
+        )
+    )
+    assert len(out) == b.num_batches()
+    assert isinstance(out[0]["labels"], jax.Array)
+    ds.close()
+
+
+def test_prefetch_propagates_errors():
+    from cst_captioning_tpu.data.prefetch import prefetch_to_device
+
+    def bad_iter():
+        yield np.zeros(3)
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(prefetch_to_device(bad_iter(), size=2))
+
+
+def test_prefetch_early_abandon_does_not_leak_worker():
+    import threading
+
+    from cst_captioning_tpu.data.prefetch import prefetch_to_device
+
+    n_before = threading.active_count()
+
+    def src():
+        for i in range(100):
+            yield np.full((2,), i)
+
+    it = prefetch_to_device(src(), size=2)
+    next(it)
+    it.close()  # abandon early -> generator finally must retire the worker
+    # worker must exit promptly rather than blocking on a full queue
+    for _ in range(50):
+        if threading.active_count() <= n_before:
+            break
+        import time
+
+        time.sleep(0.05)
+    assert threading.active_count() <= n_before
+
+
+def test_dataset_rejects_missing_weights_and_empty_captions(synth, tmp_path):
+    import json
+
+    with pytest.raises(FileNotFoundError):
+        CaptionDataset(
+            synth["info_json"],
+            {"resnet": synth["resnet"]},
+            "train",
+            6,
+            consensus_weights=str(tmp_path / "nope.npz"),
+        )
+    with open(synth["info_json"]) as f:
+        info = json.load(f)
+    info["videos"][0]["caption_ids"] = []
+    bad = tmp_path / "bad_info.json"
+    bad.write_text(json.dumps(info))
+    with pytest.raises(ValueError, match="no captions"):
+        CaptionDataset(str(bad), {"resnet": synth["resnet"]}, "train", 6)
