@@ -1,0 +1,13 @@
+"""Decoding strategies: greedy, multinomial K-rollout sampling, beam search.
+
+Rebuilds the reference's ``CaptionModel.sample`` modes (SURVEY.md §2 row 4,
+§7 step 4) as pure jittable functions over ``CaptionModel``'s ``encode`` /
+``decode_step``. All loops are ``lax.scan`` with static shapes — no Python
+per-step dispatch, so a whole decode is one XLA program.
+"""
+
+from cst_captioning_tpu.decoding.greedy import greedy_decode
+from cst_captioning_tpu.decoding.sample import sample_decode
+from cst_captioning_tpu.decoding.beam import beam_search
+
+__all__ = ["greedy_decode", "sample_decode", "beam_search"]

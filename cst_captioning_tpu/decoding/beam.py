@@ -1,0 +1,121 @@
+"""Batched fixed-shape beam search under ``lax.scan`` (eval config 5).
+
+Reference behavior: ``model.sample(feats, beam_size=5)`` per-step topk over
+beam×vocab (SURVEY.md §3.3). The classic tricky kernel (§7 "hard parts"):
+everything is static-shape —
+
+- state is ``(carry[B*W], tokens[B, W, T], scores[B, W], finished[B, W])``,
+- finished beams may only "continue" with PAD at logprob 0, so their score is
+  frozen while still participating in top-k,
+- beam 0 alone is live at t=0 (others start at -1e9) so the first expansion
+  doesn't pick W copies of the same token,
+- one ``top_k`` over the flattened ``W*V`` axis per step; parent beams are
+  gathered with ``take_along_axis`` over every carry leaf.
+
+Correctness is pinned by tests: beam=1 ≡ greedy, and a brute-force
+enumeration oracle on a tiny vocab (SURVEY.md §4 item 2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID
+from cst_captioning_tpu.decoding.common import forbid_special
+from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
+
+_NEG = -1.0e9
+
+
+def _tile_beam(tree, beam: int):
+    """[B, ...] leaves -> [B*beam, ...] (each row repeated beam times)."""
+    return jax.tree.map(
+        lambda x: jnp.repeat(x, beam, axis=0), tree
+    )
+
+
+def _gather_beams(tree, parent: jnp.ndarray, batch: int, beam: int):
+    """Select parent beams: leaves [B*W, ...] indexed by parent [B, W]."""
+    flat_idx = (jnp.arange(batch)[:, None] * beam + parent).reshape(-1)  # [B*W]
+    return jax.tree.map(lambda x: x[flat_idx], tree)
+
+
+def beam_search(
+    model: CaptionModel,
+    params,
+    feats: dict[str, jnp.ndarray],
+    masks: dict[str, jnp.ndarray],
+    beam_size: int = 5,
+    max_len: int | None = None,
+    length_penalty: float = 0.0,
+    return_all: bool = False,
+):
+    """-> (tokens [B, T], scores [B]) — or [B, W, T] / [B, W] if return_all.
+
+    ``length_penalty`` α rescales final scores by ``1/len^α`` (α=0 matches the
+    reference's pure sum-logprob ranking).
+    """
+    W = beam_size
+    T = max_len or model.cfg.max_len
+    enc: EncoderOutput = model.apply(params, feats, masks, method=CaptionModel.encode)
+    B = enc.memory.shape[0]
+    V = model.cfg.vocab_size
+
+    enc_tiled = _tile_beam(enc, W)          # leaves [B*W, ...]
+    carry0 = enc_tiled.carry
+    enc_tiled = EncoderOutput(
+        enc_tiled.memory, enc_tiled.memory_proj, enc_tiled.memory_mask, carry=()
+    )
+
+    # PAD-only continuation row for finished beams: logp 0 at PAD, -inf else
+    pad_row = jnp.full((V,), _NEG).at[PAD_ID].set(0.0)
+
+    def step(state, t):
+        carry, tokens, scores, finished, last = state
+        carry, logits = model.apply(
+            params,
+            carry,
+            last.reshape(B * W),
+            enc_tiled,
+            method=CaptionModel.decode_step,
+        )
+        logp = jax.nn.log_softmax(forbid_special(logits), axis=-1).reshape(B, W, V)
+        cont = jnp.where(finished[:, :, None], pad_row[None, None, :], logp)
+        total = scores[:, :, None] + cont                      # [B, W, V]
+        top_scores, flat = jax.lax.top_k(total.reshape(B, W * V), W)
+        parent = flat // V                                     # [B, W]
+        tok = (flat % V).astype(jnp.int32)
+
+        carry = _gather_beams(carry, parent, B, W)
+        tokens = jnp.take_along_axis(tokens, parent[:, :, None], axis=1)
+        finished = jnp.take_along_axis(finished, parent, axis=1)
+        tok = jnp.where(finished, jnp.full_like(tok, PAD_ID), tok)
+        tokens = tokens.at[:, :, t].set(tok)
+        finished = finished | (tok == EOS_ID)
+        return (carry, tokens, top_scores, finished, tok), None
+
+    state0 = (
+        carry0,
+        jnp.full((B, W, T), PAD_ID, jnp.int32),
+        jnp.concatenate([jnp.zeros((B, 1)), jnp.full((B, W - 1), _NEG)], axis=1),
+        jnp.zeros((B, W), bool),
+        jnp.full((B, W), BOS_ID, jnp.int32),
+    )
+    (_, tokens, scores, _, _), _ = jax.lax.scan(step, state0, jnp.arange(T))
+
+    if length_penalty > 0.0:
+        lengths = jnp.maximum((tokens != PAD_ID).sum(axis=-1), 1).astype(jnp.float32)
+        ranked = scores / (lengths**length_penalty)
+    else:
+        ranked = scores
+    if return_all:
+        order = jnp.argsort(-ranked, axis=1)
+        return (
+            jnp.take_along_axis(tokens, order[:, :, None], axis=1),
+            jnp.take_along_axis(ranked, order, axis=1),
+        )
+    best = jnp.argmax(ranked, axis=1)                           # [B]
+    best_tokens = jnp.take_along_axis(tokens, best[:, None, None], axis=1)[:, 0]
+    best_scores = jnp.take_along_axis(ranked, best[:, None], axis=1)[:, 0]
+    return best_tokens, best_scores

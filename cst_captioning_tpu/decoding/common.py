@@ -1,0 +1,35 @@
+"""Shared decode-loop plumbing."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID
+
+
+def forbid_special(logits: jnp.ndarray) -> jnp.ndarray:
+    """Mask PAD/BOS columns to -inf for decoding.
+
+    The reference's vocab overloads id 0 as its pad/end token, so sampling it
+    means "stop"; here PAD and EOS are distinct ids, so decoders must never
+    *emit* PAD or BOS — EOS is the only way to end a caption.
+    """
+    neg = jnp.full_like(logits[..., :1], -1e9)
+    return logits.at[..., PAD_ID].set(neg[..., 0]).at[..., BOS_ID].set(neg[..., 0])
+
+
+def step_outputs(
+    token: jnp.ndarray,      # [B] token chosen this step
+    logprob: jnp.ndarray,    # [B] its logprob
+    finished: jnp.ndarray,   # [B] bool: sequence already emitted EOS
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Force PAD / zero-logprob after EOS; returns (token, logprob, finished')."""
+    token = jnp.where(finished, jnp.full_like(token, PAD_ID), token)
+    logprob = jnp.where(finished, jnp.zeros_like(logprob), logprob)
+    finished = finished | (token == EOS_ID)
+    return token, logprob, finished
+
+
+def mask_from_tokens(tokens: jnp.ndarray) -> jnp.ndarray:
+    """[.., T] decoded tokens -> float mask counting real tokens incl. EOS."""
+    return (tokens != PAD_ID).astype(jnp.float32)

@@ -1,0 +1,44 @@
+"""Greedy decoding under ``lax.scan`` (the SCST baseline decode).
+
+Reference behavior: ``model.sample(feats, greedy)`` — argmax token per step,
+stop at EOS (SURVEY.md §3.2). Runs the shared ``decode_step``; one compiled
+program per (batch, max_len) shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.config.config import BOS_ID
+from cst_captioning_tpu.decoding.common import forbid_special, step_outputs
+from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
+
+
+def greedy_decode(
+    model: CaptionModel,
+    params,
+    feats: dict[str, jnp.ndarray],
+    masks: dict[str, jnp.ndarray],
+    max_len: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (tokens [B, T], logprobs [B, T]); PAD/0 after EOS."""
+    T = max_len or model.cfg.max_len
+    enc: EncoderOutput = model.apply(params, feats, masks, method=CaptionModel.encode)
+    B = enc.memory.shape[0]
+
+    def step(state, _):
+        carry, token, finished = state
+        carry, logits = model.apply(
+            params, carry, token, enc, method=CaptionModel.decode_step
+        )
+        logits = forbid_special(logits)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+        nxt, lp, finished = step_outputs(nxt, lp, finished)
+        return (carry, nxt, finished), (nxt, lp)
+
+    init = (enc.carry, jnp.full((B,), BOS_ID, jnp.int32), jnp.zeros((B,), bool))
+    _, (tokens, logprobs) = jax.lax.scan(step, init, None, length=T)
+    return tokens.T, logprobs.T  # scan stacks on axis 0 -> [B, T]

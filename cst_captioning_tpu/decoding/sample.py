@@ -1,0 +1,63 @@
+"""Multinomial sampling with K Monte-Carlo rollouts per clip.
+
+Reference behavior: ``model.sample(feats, multinomial × K)`` — temperature
+sampling, K rollouts per video for the consensus reward (SURVEY.md §3.2,
+BASELINE config 4). The encoder pass is shared across rollouts (computed
+once); the decode loop is vmapped over K rollout RNGs, so all K×B sequences
+decode in one XLA program — the fused "one launch" design of §7 step 5.
+
+RNG discipline: rollout k at step t uses ``fold_in(fold_in(key, k), t)`` —
+reproducible regardless of batch sharding or rollout count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.config.config import BOS_ID
+from cst_captioning_tpu.decoding.common import forbid_special, step_outputs
+from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
+
+
+def sample_decode(
+    model: CaptionModel,
+    params,
+    feats: dict[str, jnp.ndarray],
+    masks: dict[str, jnp.ndarray],
+    rng: jax.Array,
+    num_rollouts: int = 1,
+    temperature: float = 1.0,
+    max_len: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (tokens [K, B, T], logprobs [K, B, T]); PAD/0 after EOS.
+
+    ``logprobs`` are the *untempered* model logprobs of the sampled tokens
+    (the REINFORCE estimator needs log p_model, not log p_temperature).
+    """
+    T = max_len or model.cfg.max_len
+    enc: EncoderOutput = model.apply(params, feats, masks, method=CaptionModel.encode)
+    B = enc.memory.shape[0]
+
+    def rollout(k_rng):
+        def step(state, t):
+            carry, token, finished = state
+            carry, logits = model.apply(
+                params, carry, token, enc, method=CaptionModel.decode_step
+            )
+            logits = forbid_special(logits)
+            step_rng = jax.random.fold_in(k_rng, t)
+            nxt = jax.random.categorical(step_rng, logits / temperature, axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+            nxt, lp, finished = step_outputs(nxt, lp, finished)
+            return (carry, nxt, finished), (nxt, lp)
+
+        init = (enc.carry, jnp.full((B,), BOS_ID, jnp.int32), jnp.zeros((B,), bool))
+        _, (tokens, logprobs) = jax.lax.scan(step, init, jnp.arange(T))
+        return tokens.T, logprobs.T
+
+    keys = jax.vmap(lambda k: jax.random.fold_in(rng, k))(jnp.arange(num_rollouts))
+    tokens, logprobs = jax.vmap(rollout)(keys)
+    return tokens, logprobs

@@ -1,0 +1,13 @@
+"""Loss layer: masked XE, consensus-weighted XE, REINFORCE.
+
+Rebuilds the reference's ``CrossEntropyCriterion`` / ``RewardCriterion``
+(SURVEY.md §2 rows 5-6) as pure jittable functions.
+"""
+
+from cst_captioning_tpu.losses.losses import (
+    masked_cross_entropy,
+    reinforce_loss,
+    sequence_log_probs,
+)
+
+__all__ = ["masked_cross_entropy", "reinforce_loss", "sequence_log_probs"]

@@ -1,0 +1,62 @@
+"""Pure loss functions (all f32, all mask-aware, all jittable).
+
+- :func:`masked_cross_entropy` — the reference's ``CrossEntropyCriterion``:
+  token-masked sequence XE, normalized by total token count; the ``weights``
+  argument is the WXE variant (per-caption consensus weight multiplying that
+  caption's token losses, CST paper §3.2).
+- :func:`reinforce_loss` — the reference's ``RewardCriterion``:
+  ``-(reward - baseline) * logprob`` over sampled tokens, masked and
+  normalized the same way (advantage is per-sequence, broadcast over steps).
+- :func:`sequence_log_probs` — gather per-token logprobs of given sequences
+  from logits (used to re-score sampled rollouts differentiably in the RL
+  update, SURVEY.md §7 step 5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def sequence_log_probs(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """logits [B, T, V], tokens [B, T] -> per-token logprobs [B, T] (f32)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
+
+
+def masked_cross_entropy(
+    logits: jnp.ndarray,           # [B, T, V]
+    labels: jnp.ndarray,           # [B, T] int
+    mask: jnp.ndarray,             # [B, T] 1/0 on real tokens (incl. EOS)
+    weights: jnp.ndarray | None = None,   # [B] per-caption consensus weights
+    label_smoothing: float = 0.0,
+) -> jnp.ndarray:
+    """Masked (optionally consensus-weighted) sequence XE, mean over tokens."""
+    logits = logits.astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    if label_smoothing > 0.0:
+        V = logits.shape[-1]
+        soft = optax.smooth_labels(jax.nn.one_hot(labels, V), label_smoothing)
+        per_tok = optax.softmax_cross_entropy(logits, soft)
+    else:
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    if weights is not None:
+        mask = mask * weights.astype(jnp.float32)[:, None]
+    return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def reinforce_loss(
+    log_probs: jnp.ndarray,        # [B, T] per-token logprobs of sampled seqs
+    mask: jnp.ndarray,             # [B, T] 1/0 on sampled tokens (incl. EOS)
+    advantage: jnp.ndarray,        # [B] reward - baseline (host-computed)
+) -> jnp.ndarray:
+    """REINFORCE: -E[advantage * logp], masked, mean over tokens.
+
+    ``advantage`` is treated as a constant (stop_gradient): gradients flow
+    only through ``log_probs``.
+    """
+    mask = mask.astype(jnp.float32)
+    adv = jax.lax.stop_gradient(advantage.astype(jnp.float32))[:, None]
+    loss = -(adv * log_probs.astype(jnp.float32) * mask)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)

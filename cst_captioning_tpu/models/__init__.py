@@ -1,0 +1,30 @@
+"""Model layer: Flax caption models (encoders + LSTM decoder).
+
+Rebuilds the capability of the reference's ``model.py::CaptionModel``
+(SURVEY.md §2 row 4) as jit-compiled Flax modules with one unifying design
+decision: every encoder produces a *memory* — a ``[B, M, E]`` bank of slots
+plus a validity mask — and a single decoder cell attends over that memory at
+each step:
+
+- mean-pool encoder  -> one slot per modality (M = #modalities),
+- temporal-attention -> one slot per frame, all modalities concatenated along
+  the frame axis (M = sum of frame counts).
+
+This gives one decode path for every config (greedy / sampling / beam reuse
+the same ``decode_step``), static shapes throughout, and attention that maps
+onto a single batched matmul per step for the MXU.
+"""
+
+from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
+from cst_captioning_tpu.models.encoders import MeanPoolEncoder, TemporalAttentionEncoder
+from cst_captioning_tpu.models.attention import AdditiveAttention
+from cst_captioning_tpu.models.decoder import DecoderCell
+
+__all__ = [
+    "CaptionModel",
+    "EncoderOutput",
+    "MeanPoolEncoder",
+    "TemporalAttentionEncoder",
+    "AdditiveAttention",
+    "DecoderCell",
+]

@@ -1,0 +1,124 @@
+"""CaptionModel: encoder + LSTM decoder with shared single-step semantics.
+
+The reference's ``CaptionModel`` couples ``forward`` (teacher forcing) and
+``sample`` (greedy/multinomial/beam) in one torch module (SURVEY.md §2 row 4).
+Here the same capability is split TPU-style:
+
+- :meth:`encode`       — one pass building the memory bank + initial carry,
+- :meth:`decode_step`  — one decoder step (used by every decoding strategy),
+- :meth:`__call__`     — teacher-forced unroll of ``decode_step`` via
+  ``nn.scan`` (compiled to a single fused XLA while loop; no per-step Python).
+
+Teacher forcing and all samplers therefore share parameters *and* code, which
+is what makes the unroll-consistency test (SURVEY.md §4 item 2) meaningful.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import flax.struct
+import jax.numpy as jnp
+
+from cst_captioning_tpu.config.config import BOS_ID, ModelConfig
+from cst_captioning_tpu.models.decoder import Carry, DecoderCell
+from cst_captioning_tpu.models.encoders import (
+    MeanPoolEncoder,
+    TemporalAttentionEncoder,
+    masked_mean,
+)
+
+
+@flax.struct.dataclass
+class EncoderOutput:
+    memory: jnp.ndarray       # [B, M, E]
+    memory_proj: jnp.ndarray  # [B, M, d_att] attention key projection
+    memory_mask: jnp.ndarray  # [B, M]
+    carry: Carry              # initial LSTM carry
+
+
+def shift_right(labels: jnp.ndarray) -> jnp.ndarray:
+    """[B, T] target tokens -> decoder inputs [B, T] starting with BOS."""
+    bos = jnp.full((labels.shape[0], 1), BOS_ID, dtype=labels.dtype)
+    return jnp.concatenate([bos, labels[:, :-1]], axis=1)
+
+
+def _scan_step(mdl, carry, token, memory, memory_proj, memory_mask, deterministic):
+    return mdl.cell(carry, token, memory, memory_proj, memory_mask, deterministic)
+
+
+class CaptionModel(nn.Module):
+    cfg: ModelConfig
+
+    def setup(self):
+        cfg = self.cfg
+        if cfg.encoder == "meanpool":
+            self.encoder = MeanPoolEncoder(cfg, name="encoder")
+        else:
+            self.encoder = TemporalAttentionEncoder(cfg, name="encoder")
+        self.cell = DecoderCell(cfg, name="cell")
+        dtype = jnp.dtype(cfg.dtype)
+        pdtype = jnp.dtype(cfg.param_dtype)
+        # LSTM carry is initialized from the pooled memory (the reference
+        # instead feeds the video feature at step 0 — same information path,
+        # but this keeps step 0 identical to every other step for the scan)
+        self.init_c = [
+            nn.Dense(cfg.d_hidden, name=f"init_c{i}", dtype=dtype, param_dtype=pdtype)
+            for i in range(cfg.num_layers)
+        ]
+        self.init_h = [
+            nn.Dense(cfg.d_hidden, name=f"init_h{i}", dtype=dtype, param_dtype=pdtype)
+            for i in range(cfg.num_layers)
+        ]
+
+    # ---- encoding ----------------------------------------------------------
+
+    def encode(
+        self, feats: dict[str, jnp.ndarray], masks: dict[str, jnp.ndarray]
+    ) -> EncoderOutput:
+        memory, mmask = self.encoder(feats, masks)
+        memory_proj = self.cell.project_memory(memory)
+        ctx0 = masked_mean(memory, mmask, axis=1)
+        carry = tuple(
+            (jnp.tanh(self.init_c[i](ctx0)), jnp.tanh(self.init_h[i](ctx0)))
+            for i in range(self.cfg.num_layers)
+        )
+        return EncoderOutput(memory, memory_proj, mmask, carry)
+
+    # ---- single step (greedy / sampling / beam all call this) ---------------
+
+    def decode_step(
+        self,
+        carry: Carry,
+        token: jnp.ndarray,
+        enc: EncoderOutput,
+        deterministic: bool = True,
+    ) -> tuple[Carry, jnp.ndarray]:
+        return self.cell(
+            carry, token, enc.memory, enc.memory_proj, enc.memory_mask, deterministic
+        )
+
+    # ---- teacher forcing -----------------------------------------------------
+
+    def __call__(
+        self,
+        feats: dict[str, jnp.ndarray],
+        masks: dict[str, jnp.ndarray],
+        labels: jnp.ndarray,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        """-> logits [B, T, V] (f32); logits[:, t] predicts labels[:, t]."""
+        enc = self.encode(feats, masks)
+        inputs = shift_right(labels)
+        scan = nn.scan(
+            functools.partial(_scan_step, deterministic=not train),
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            in_axes=(1, nn.broadcast, nn.broadcast, nn.broadcast),
+            out_axes=1,
+        )
+        _, logits = scan(
+            self, enc.carry, inputs, enc.memory, enc.memory_proj, enc.memory_mask
+        )
+        return logits

@@ -1,0 +1,74 @@
+"""LSTM decoder cell with input-feed attention context.
+
+One step of the caption decoder (reference ``model.py`` decode loop,
+SURVEY.md §2 row 4): embed the previous token, attend over the encoder memory
+with the previous top-layer hidden state, feed ``[word_emb, context]`` through
+the LSTM stack, project to vocab logits. Written as a single-step module so
+teacher forcing (``nn.scan``), greedy/multinomial sampling and beam search all
+share the exact same parameters and code path.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from cst_captioning_tpu.config.config import ModelConfig
+from cst_captioning_tpu.models.attention import AdditiveAttention
+
+# carry: tuple over layers of LSTM (c, h) pairs
+Carry = tuple[tuple[jnp.ndarray, jnp.ndarray], ...]
+
+
+class DecoderCell(nn.Module):
+    cfg: ModelConfig
+
+    def setup(self):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        pdtype = jnp.dtype(cfg.param_dtype)
+        self.word_embed = nn.Embed(
+            cfg.vocab_size, cfg.d_embed, name="word_embed",
+            dtype=dtype, param_dtype=pdtype,
+        )
+        self.attention = AdditiveAttention(
+            d_att=cfg.d_att, dtype=dtype, param_dtype=pdtype, name="attention"
+        )
+        self.lstm = [
+            nn.OptimizedLSTMCell(
+                cfg.d_hidden, dtype=dtype, param_dtype=pdtype, name=f"lstm{i}"
+            )
+            for i in range(cfg.num_layers)
+        ]
+        self.out_proj = nn.Dense(
+            cfg.vocab_size, name="out_proj", dtype=dtype, param_dtype=pdtype
+        )
+        self.dropout = nn.Dropout(rate=cfg.dropout)
+
+    def project_memory(self, memory: jnp.ndarray) -> jnp.ndarray:
+        return self.attention.project_memory(memory)
+
+    def __call__(
+        self,
+        carry: Carry,
+        token: jnp.ndarray,        # [B] int32 previous token
+        memory: jnp.ndarray,       # [B, M, E]
+        memory_proj: jnp.ndarray,  # [B, M, d_att]
+        memory_mask: jnp.ndarray,  # [B, M]
+        deterministic: bool = True,
+    ) -> tuple[Carry, jnp.ndarray]:
+        """One decode step -> (new carry, logits [B, V] float32)."""
+        h_top = carry[-1][1]
+        ctx = self.attention(h_top, memory, memory_proj, memory_mask)
+        x = jnp.concatenate([self.word_embed(token), ctx], axis=-1)
+        x = self.dropout(x, deterministic=deterministic)
+        new_carry = []
+        for i, cell in enumerate(self.lstm):
+            c_i, x = cell(carry[i], x)
+            new_carry.append(c_i)
+            if i + 1 < len(self.lstm):
+                x = self.dropout(x, deterministic=deterministic)
+        x = self.dropout(x, deterministic=deterministic)
+        # logits in f32: softmax/loss stability is worth the cast
+        logits = self.out_proj(x).astype(jnp.float32)
+        return tuple(new_carry), logits

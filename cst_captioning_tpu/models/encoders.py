@@ -1,0 +1,76 @@
+"""Feature encoders: every encoder yields a memory bank [B, M, E] + mask.
+
+Capability map to the reference (SURVEY.md §2 row 4):
+
+- :class:`MeanPoolEncoder` — config 1 (MSVD mean-pool): masked mean over
+  frames per modality, one memory slot per modality. The decoder's attention
+  over modality slots subsumes the reference's concat-and-project fusion.
+- :class:`TemporalAttentionEncoder` — config 2 (MSR-VTT temporal attention):
+  per-frame embeddings, all modalities concatenated along the frame axis, so
+  one attention pass spans every frame of every modality. Modalities with
+  different frame counts/rates need no alignment.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from cst_captioning_tpu.config.config import ModelConfig
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Mean over ``axis`` counting only mask==1 positions."""
+    mask = mask.astype(x.dtype)
+    num = jnp.sum(x * jnp.expand_dims(mask, -1), axis=axis)
+    den = jnp.maximum(jnp.sum(mask, axis=axis), 1.0)[..., None]
+    return num / den
+
+
+class MeanPoolEncoder(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self, feats: dict[str, jnp.ndarray], masks: dict[str, jnp.ndarray]
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """feats[name]: [B, F, D_name] -> (memory [B, n_mod, E], mask [B, n_mod])."""
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        slots = []
+        for name, _ in cfg.modalities:
+            pooled = masked_mean(feats[name].astype(dtype), masks[name], axis=1)
+            emb = nn.Dense(
+                cfg.d_embed, name=f"embed_{name}",
+                dtype=dtype, param_dtype=jnp.dtype(cfg.param_dtype),
+            )(pooled)
+            slots.append(jnp.tanh(emb))
+        memory = jnp.stack(slots, axis=1)                        # [B, n_mod, E]
+        mmask = jnp.ones(memory.shape[:2], dtype=jnp.float32)
+        return memory, mmask
+
+
+class TemporalAttentionEncoder(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self, feats: dict[str, jnp.ndarray], masks: dict[str, jnp.ndarray]
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """-> (memory [B, sum_F, E], mask [B, sum_F]): frame slots, all modalities."""
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        banks, bmasks = [], []
+        for name, _ in cfg.modalities:
+            emb = nn.Dense(
+                cfg.d_embed, name=f"embed_{name}",
+                dtype=dtype, param_dtype=jnp.dtype(cfg.param_dtype),
+            )(feats[name].astype(dtype))                         # [B, F, E]
+            banks.append(jnp.tanh(emb))
+            bmasks.append(masks[name])
+        memory = jnp.concatenate(banks, axis=1)
+        mmask = jnp.concatenate(bmasks, axis=1).astype(jnp.float32)
+        # zero padded slots so masked positions can't leak through the
+        # value-sum even if a downstream consumer forgets the mask
+        memory = memory * mmask[..., None].astype(memory.dtype)
+        return memory, mmask

@@ -2,15 +2,35 @@
 
 Per SURVEY.md §4 item 4: distributed paths (shard_map/pmap grad allreduce,
 per-device RNG) are exercised on fake CPU devices so the suite runs anywhere;
-the real TPU is reserved for bench.py. Must run before the first jax import.
+the real TPU is reserved for bench.py.
+
+This environment preloads jax at interpreter start (a sitecustomize on
+PYTHONPATH registers the ``axon`` TPU backend and sets JAX_PLATFORMS=axon), so
+setting env vars here is too late for jax's config — but the *backend* is not
+initialized until first use, so ``jax.config.update`` + XLA_FLAGS (read at
+backend init) still take effect. Keep this module free of any call that
+touches devices.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# XLA_FLAGS is read by the CPU client at backend-init time, so mutating the
+# env here (pre-init) works even though jax itself is already imported.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests may spawn
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+try:
+    _backends = jax._src.xla_bridge._backends  # private; best-effort probe
+except AttributeError:
+    _backends = None
+assert not _backends, (
+    "a JAX backend was initialized before conftest ran; CPU forcing is too late"
+)
