@@ -1,0 +1,134 @@
+"""RL-phase throughput benchmark (the BASELINE.json north-star metric).
+
+Measures clips/sec/chip of the full CST self-critical step on the flagship
+MSR-VTT configuration (BASELINE config 4: temporal-attention encoder,
+ResNet+C3D features, K=5 Monte-Carlo rollouts, CIDEr-D consensus reward):
+fused greedy+K-rollout decode dispatch -> host consensus reward -> jitted
+REINFORCE update.
+
+Prints ONE JSON line:
+    {"metric": "rl_clips_per_sec_per_chip", "value": N, "unit": "clips/s/chip",
+     "vs_baseline": N}
+
+``vs_baseline``: BASELINE.json recorded no absolute reference numbers
+(``published: {}``; the reference mount was empty — SURVEY.md §0/§6), so the
+denominator is the north-star TARGET itself: 3× an assumed 2017 single-GPU
+RL-phase throughput of 100 clips/s (batch-64 LSTM sampling + host CIDEr-D on
+a Maxwell/Pascal-era GPU). vs_baseline >= 1.0 therefore means "met the ≥3×
+target under this assumption". Replace the constant when the reference
+becomes readable.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+ASSUMED_REFERENCE_CLIPS_PER_SEC = 100.0   # 2017 single-GPU estimate (see above)
+TARGET_MULTIPLier = 3.0
+
+BATCH = 64
+FRAMES = 20
+MAX_LEN = 30
+K_ROLLOUTS = 5
+VOCAB = 9000
+MEASURE_STEPS = 6
+WARMUP_STEPS = 2
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from cst_captioning_tpu.config.config import ModelConfig, RLConfig, TrainConfig
+    from cst_captioning_tpu.data.vocab import Vocab
+    from cst_captioning_tpu.models import CaptionModel
+    from cst_captioning_tpu.rl import RewardComputer, SCSTTrainer
+    from cst_captioning_tpu.train import create_train_state, make_optimizer
+
+    n_chips = len(jax.devices())
+    print(f"bench: backend={jax.default_backend()} chips={n_chips}", file=sys.stderr)
+
+    cfg = ModelConfig(
+        vocab_size=VOCAB,
+        modalities=(("resnet", 2048), ("c3d", 500)),
+        d_embed=512,
+        d_hidden=512,
+        d_att=256,
+        encoder="temporal_attention",
+        dropout=0.5,
+        max_len=MAX_LEN,
+        max_frames=FRAMES,
+        dtype="bfloat16",
+    )
+    model = CaptionModel(cfg)
+    rng = np.random.default_rng(0)
+    feats = {
+        "resnet": jnp.asarray(rng.normal(size=(BATCH, FRAMES, 2048)), jnp.float32),
+        "c3d": jnp.asarray(rng.normal(size=(BATCH, FRAMES, 500)), jnp.float32),
+    }
+    masks = {k: jnp.ones((BATCH, FRAMES), jnp.float32) for k in feats}
+    labels = jnp.asarray(rng.integers(4, VOCAB, size=(BATCH, MAX_LEN)), jnp.int32)
+
+    tx = make_optimizer(TrainConfig(lr=2e-5, grad_clip=5.0), 100)
+    state = create_train_state(model, tx, (feats, masks, labels), seed=0)
+
+    # synthetic consensus pools: 5 GT captions per video over a real vocab
+    words = [f"w{i}" for i in range(VOCAB - 4)]
+    vocab = Vocab.from_corpus_words(words)
+    vids = [f"video{i}" for i in range(BATCH)]
+    gts = {
+        v: [
+            " ".join(rng.choice(words[:200], size=rng.integers(6, 12)))
+            for _ in range(5)
+        ]
+        for v in vids
+    }
+    reward = RewardComputer(vocab, gts, cider_weight=1.0, bleu_weight=0.5)
+    rl_cfg = RLConfig(enabled=True, num_rollouts=K_ROLLOUTS, baseline="greedy")
+    scst = SCSTTrainer(model, reward, rl_cfg, max_len=MAX_LEN)
+
+    key = jax.random.key(0)
+    t_compile = time.perf_counter()
+    for i in range(WARMUP_STEPS):
+        key, sk = jax.random.split(key)
+        state, m = scst.train_step(state, feats, masks, vids, sk)
+    jax.block_until_ready(state.params)
+    print(
+        f"bench: warmup+compile {time.perf_counter() - t_compile:.1f}s "
+        f"(reward_mean={m['reward_mean']:.3f})",
+        file=sys.stderr,
+    )
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        key, sk = jax.random.split(key)
+        state, m = scst.train_step(state, feats, masks, vids, sk)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    clips_per_sec = BATCH * MEASURE_STEPS / dt
+    per_chip = clips_per_sec / max(n_chips, 1)
+    target = ASSUMED_REFERENCE_CLIPS_PER_SEC * TARGET_MULTIPLier
+    print(
+        f"bench: {MEASURE_STEPS} steps in {dt:.2f}s -> {per_chip:.1f} clips/s/chip "
+        f"(K={K_ROLLOUTS} rollouts, B={BATCH}, T={MAX_LEN})",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "rl_clips_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "clips/s/chip",
+                "vs_baseline": round(per_chip / target, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""Checkpointing: atomic msgpack saves, best/latest policy, XE->RL handoff.
+
+Capability parity with the reference's ``torch.save`` of model/optimizer/
+``infos`` + ``--start_from`` resume (SURVEY.md §3.5, §5): atomic writes (tmp +
+rename) so a crash never corrupts the latest checkpoint, ``resume="auto"``
+picks the newest valid one, and the RL phase loads params-only from the best
+XE checkpoint with a fresh optimizer.
+"""
+
+from cst_captioning_tpu.ckpt.checkpoint import (
+    CheckpointManager,
+    load_params,
+    load_state,
+    save_state,
+)
+
+__all__ = ["CheckpointManager", "save_state", "load_state", "load_params"]

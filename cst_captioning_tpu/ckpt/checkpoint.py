@@ -1,0 +1,137 @@
+"""Atomic TrainState checkpoints via flax.serialization msgpack.
+
+Layout per checkpoint name (e.g. ``best`` / ``latest`` / ``step_1200``):
+
+    <dir>/<name>/state.msgpack   — params + opt state + step + rng
+    <dir>/<name>/infos.json      — epoch, metric history, config snapshot
+
+msgpack via ``flax.serialization`` (not pickle) keeps checkpoints
+language-neutral and safe to load; writes go to a tmp dir + atomic rename.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any
+
+import jax
+from flax import serialization
+
+from cst_captioning_tpu.train.state import TrainState
+
+STATE_FILE = "state.msgpack"
+INFOS_FILE = "infos.json"
+
+
+def _is_prng_key(x) -> bool:
+    return hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def _keys_to_data(tree):
+    """Typed PRNG keys -> raw uint32 key data (msgpack can't hold key dtypes)."""
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if _is_prng_key(x) else x, tree
+    )
+
+
+def _data_to_keys(loaded, template):
+    """Re-wrap raw key data as typed keys wherever the template has them."""
+    return jax.tree.map(
+        lambda t, x: jax.random.wrap_key_data(x) if _is_prng_key(t) else x,
+        template,
+        loaded,
+    )
+
+
+def save_state(ckpt_dir: str, name: str, state: TrainState,
+               infos: dict[str, Any] | None = None) -> str:
+    """Atomically write state+infos under ``ckpt_dir/name``; returns the path."""
+    final = os.path.join(ckpt_dir, name)
+    tmp = final + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp, exist_ok=True)
+    # fully materialize on host before serializing
+    host_state = _keys_to_data(jax.device_get(state))
+    with open(os.path.join(tmp, STATE_FILE), "wb") as f:
+        f.write(serialization.to_bytes(host_state))
+    with open(os.path.join(tmp, INFOS_FILE), "w") as f:
+        json.dump(infos or {}, f, indent=2, default=float)
+    if os.path.exists(final):
+        shutil.rmtree(final)
+    os.replace(tmp, final)
+    return final
+
+
+def load_state(ckpt_dir: str, name: str, template: TrainState) -> tuple[TrainState, dict]:
+    """Restore a full TrainState (shape/dtype from ``template``) + infos."""
+    path = os.path.join(ckpt_dir, name)
+    data_template = _keys_to_data(jax.device_get(template))
+    with open(os.path.join(path, STATE_FILE), "rb") as f:
+        loaded = serialization.from_bytes(data_template, f.read())
+    state = _data_to_keys(loaded, template)
+    infos = {}
+    infos_path = os.path.join(path, INFOS_FILE)
+    if os.path.exists(infos_path):
+        with open(infos_path) as f:
+            infos = json.load(f)
+    return state, infos
+
+
+def load_params(ckpt_dir: str, name: str, params_template) -> Any:
+    """Params-only restore — the XE -> RL handoff (fresh optimizer)."""
+    path = os.path.join(ckpt_dir, name, STATE_FILE)
+    with open(path, "rb") as f:
+        blob = f.read()
+    state_dict = serialization.msgpack_restore(blob)
+    return serialization.from_state_dict(params_template, state_dict["params"])
+
+
+class CheckpointManager:
+    """best-by-metric + latest policy with auto-resume (SURVEY.md §5)."""
+
+    def __init__(self, ckpt_dir: str, metric: str = "CIDEr-D", mode: str = "max"):
+        self.ckpt_dir = ckpt_dir
+        self.metric = metric
+        self.mode = mode
+        self.best_value: float | None = None
+        os.makedirs(ckpt_dir, exist_ok=True)
+        # recover best_value from an existing best checkpoint (resume case)
+        best_infos = os.path.join(ckpt_dir, "best", INFOS_FILE)
+        if os.path.exists(best_infos):
+            with open(best_infos) as f:
+                self.best_value = json.load(f).get("best_value")
+
+    def _improved(self, value: float) -> bool:
+        if self.best_value is None:
+            return True
+        return value > self.best_value if self.mode == "max" else value < self.best_value
+
+    def save(self, state: TrainState, value: float | None = None,
+             infos: dict | None = None) -> bool:
+        """Save 'latest' always; promote to 'best' when the metric improves.
+
+        Returns True when a new best was recorded.
+        """
+        infos = dict(infos or {})
+        infos["best_value"] = self.best_value
+        save_state(self.ckpt_dir, "latest", state, infos)
+        if value is not None and self._improved(value):
+            self.best_value = float(value)
+            infos["best_value"] = self.best_value
+            save_state(self.ckpt_dir, "best", state, infos)
+            return True
+        return False
+
+    def restore_latest(self, template: TrainState) -> tuple[TrainState, dict] | None:
+        """Auto-resume: newest valid checkpoint (latest, falling back to best)."""
+        for name in ("latest", "best"):
+            path = os.path.join(self.ckpt_dir, name, STATE_FILE)
+            if os.path.exists(path):
+                try:
+                    return load_state(self.ckpt_dir, name, template)
+                except Exception:
+                    continue  # corrupt/partial: try the next candidate
+        return None

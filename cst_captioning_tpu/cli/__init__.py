@@ -1,0 +1,9 @@
+"""CLI entry points (the reference's ``train.py`` / ``test.py`` / Makefile).
+
+Usage:
+    python -m cst_captioning_tpu.cli.train --preset msrvtt_xe_attention \\
+        --info-json data/info.json --feature resnet=data/resnet.h5 \\
+        --feature c3d=data/c3d.h5 --set train__epochs=50
+    python -m cst_captioning_tpu.cli.eval --preset msrvtt_eval_beam5 ...
+    python -m cst_captioning_tpu.cli.preprocess --captions raw.json --out-dir data/
+"""

@@ -1,0 +1,79 @@
+"""Shared CLI plumbing: preset loading + typed overrides + dataset wiring."""
+
+from __future__ import annotations
+
+import argparse
+import ast
+
+from cst_captioning_tpu.config import ExperimentConfig, get_preset
+from cst_captioning_tpu.data.dataset import CaptionDataset
+
+
+def add_common_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--preset", required=True, help="named experiment preset")
+    p.add_argument("--info-json", default="", help="dataset info.json path")
+    p.add_argument(
+        "--feature",
+        action="append",
+        default=[],
+        metavar="NAME=PATH",
+        help="modality h5 file, repeatable (e.g. resnet=feats/resnet.h5)",
+    )
+    p.add_argument(
+        "--set",
+        action="append",
+        default=[],
+        metavar="SECTION__FIELD=VALUE",
+        help="config override, repeatable (e.g. train__epochs=10)",
+    )
+    p.add_argument("--log-jsonl", default="", help="structured event log path")
+
+
+def parse_overrides(pairs: list[str]) -> dict:
+    out = {}
+    for pair in pairs:
+        key, sep, raw = pair.partition("=")
+        if not sep:
+            raise SystemExit(f"--set expects SECTION__FIELD=VALUE, got {pair!r}")
+        try:
+            out[key] = ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            out[key] = raw  # plain string
+    return out
+
+
+def load_config(args: argparse.Namespace) -> ExperimentConfig:
+    cfg = get_preset(args.preset)
+    overrides = parse_overrides(args.set)
+    if overrides:
+        cfg = cfg.override(**overrides)
+    return cfg
+
+
+def feature_map(args: argparse.Namespace) -> dict[str, str]:
+    out = {}
+    for pair in args.feature:
+        name, sep, path = pair.partition("=")
+        if not sep:
+            raise SystemExit(f"--feature expects NAME=PATH, got {pair!r}")
+        out[name] = path
+    return out
+
+
+def open_dataset(args: argparse.Namespace, cfg: ExperimentConfig,
+                 split: str) -> CaptionDataset:
+    if not args.info_json:
+        raise SystemExit("--info-json is required for real data runs")
+    feats = feature_map(args)
+    missing = [n for n in cfg.model.modality_names if n not in feats]
+    if missing:
+        raise SystemExit(
+            f"preset {cfg.name!r} needs --feature for modalities: {missing}"
+        )
+    return CaptionDataset(
+        args.info_json,
+        {n: feats[n] for n in cfg.model.modality_names},
+        split=split,
+        max_frames=cfg.model.max_frames,
+        consensus_weights=cfg.data.consensus_weights,
+    )

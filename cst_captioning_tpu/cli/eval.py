@@ -1,0 +1,52 @@
+"""Evaluation CLI: beam-search decode a split + COCO-style metric table.
+
+Reference equivalent: ``python test.py --beam_size 5 --checkpoint ...``
+(SURVEY.md §3.3, BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+
+from cst_captioning_tpu.cli.common import add_common_args, load_config, open_dataset
+from cst_captioning_tpu.ckpt import load_params
+from cst_captioning_tpu.eval.evaluator import evaluate_split
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.train.steps import batch_arrays
+from cst_captioning_tpu.data.batcher import Batcher
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_common_args(p)
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--ckpt-name", default="best")
+    p.add_argument("--split", default="")
+    p.add_argument("--results-json", default="results.json")
+    args = p.parse_args(argv)
+
+    cfg = load_config(args)
+    split = args.split or cfg.eval.split
+    ds = open_dataset(args, cfg, split)
+
+    model = CaptionModel(cfg.model)
+    # template params from a throwaway init on one batch
+    sample = next(iter(
+        Batcher(ds, batch_size=2, max_len=cfg.model.max_len, mode="video").epoch(False)
+    ))
+    feats, masks, labels, *_ = batch_arrays(sample)
+    template = model.init(jax.random.key(0), feats, masks, labels)
+    params = load_params(args.ckpt_dir, args.ckpt_name, template)
+
+    result = evaluate_split(
+        model, params, ds, cfg.eval,
+        batch_size=cfg.data.batch_size, results_json=args.results_json,
+    )
+    print(json.dumps(result["metrics"], indent=2, default=float))
+
+
+if __name__ == "__main__":
+    main()

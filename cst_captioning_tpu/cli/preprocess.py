@@ -1,0 +1,53 @@
+"""Preprocessing CLI: captions json -> info.json + consensus weights + df.
+
+Reference equivalent: the standalone vocab/tokenize/consensus/df scripts
+(SURVEY.md §2 row 3). Input format:
+
+    {"videos": [{"id": "video0", "split": "train",
+                 "captions": ["a man is cooking", ...]}, ...]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from cst_captioning_tpu.data.preprocess import (
+    build_info_json,
+    compute_cider_df,
+    compute_consensus_weights,
+    tokenize_captions,
+)
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--captions", required=True, help="raw captions json")
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--min-count", type=int, default=1, help="vocab threshold")
+    args = p.parse_args(argv)
+
+    with open(args.captions) as f:
+        raw = json.load(f)
+    caps = {v["id"]: v["captions"] for v in raw["videos"]}
+    splits = {v["id"]: v.get("split", "train") for v in raw["videos"]}
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    info_path = os.path.join(args.out_dir, "info.json")
+    build_info_json(info_path, caps, splits, min_count=args.min_count)
+
+    train_caps = {vid: c for vid, c in caps.items() if splits[vid] == "train"}
+    tokenized = tokenize_captions(train_caps)
+    df = compute_cider_df(tokenized)
+    df.save(os.path.join(args.out_dir, "cider_df.pkl"))
+
+    weights = compute_consensus_weights(tokenized, df=df)
+    np.savez(os.path.join(args.out_dir, "consensus_weights.npz"), **weights)
+    print(f"wrote info.json, cider_df.pkl, consensus_weights.npz to {args.out_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -133,9 +133,11 @@ class EvalConfig:
 
     beam_size: int = 5
     max_len: int = 30
+    min_len: int = 0              # suppress EOS for the first N steps (0 = off)
     length_penalty: float = 0.0         # 0 = pure sum-logprob (reference behavior)
     split: str = "test"
-    metrics: tuple[str, ...] = ("Bleu_4", "METEOR", "ROUGE_L", "CIDEr", "CIDEr-D")
+    # selector names understood by metrics.scorer.CaptionScorer
+    metrics: tuple[str, ...] = ("Bleu", "ROUGE_L", "METEOR_approx", "CIDEr", "CIDEr-D")
     results_json: str = ""
 
 

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID
-from cst_captioning_tpu.decoding.common import forbid_special
+from cst_captioning_tpu.decoding.common import apply_min_len, forbid_special
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
 
 _NEG = -1.0e9
@@ -48,6 +48,7 @@ def beam_search(
     masks: dict[str, jnp.ndarray],
     beam_size: int = 5,
     max_len: int | None = None,
+    min_len: int = 0,
     length_penalty: float = 0.0,
     return_all: bool = False,
 ):
@@ -80,7 +81,8 @@ def beam_search(
             enc_tiled,
             method=CaptionModel.decode_step,
         )
-        logp = jax.nn.log_softmax(forbid_special(logits), axis=-1).reshape(B, W, V)
+        logits = apply_min_len(forbid_special(logits), t, min_len)
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, W, V)
         cont = jnp.where(finished[:, :, None], pad_row[None, None, :], logp)
         total = scores[:, :, None] + cont                      # [B, W, V]
         top_scores, flat = jax.lax.top_k(total.reshape(B, W * V), W)

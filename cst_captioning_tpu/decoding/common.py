@@ -33,3 +33,16 @@ def step_outputs(
 def mask_from_tokens(tokens: jnp.ndarray) -> jnp.ndarray:
     """[.., T] decoded tokens -> float mask counting real tokens incl. EOS."""
     return (tokens != PAD_ID).astype(jnp.float32)
+
+
+def apply_min_len(logits: jnp.ndarray, t, min_len: int) -> jnp.ndarray:
+    """Suppress EOS while step ``t`` < ``min_len`` (prevents empty captions).
+
+    The reference ranks beams by pure sum-logprob, which lets EOS-first beams
+    win on weak models; a min caption length is the standard guard. No-op for
+    ``min_len`` 0 (reference behavior).
+    """
+    if min_len <= 0:
+        return logits
+    blocked = logits.at[..., EOS_ID].set(-1.0e9)
+    return jnp.where(t < min_len, blocked, logits)

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from cst_captioning_tpu.config.config import BOS_ID
-from cst_captioning_tpu.decoding.common import forbid_special, step_outputs
+from cst_captioning_tpu.decoding.common import apply_min_len, forbid_special, step_outputs
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
 
 
@@ -21,18 +21,19 @@ def greedy_decode(
     feats: dict[str, jnp.ndarray],
     masks: dict[str, jnp.ndarray],
     max_len: int | None = None,
+    min_len: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """-> (tokens [B, T], logprobs [B, T]); PAD/0 after EOS."""
     T = max_len or model.cfg.max_len
     enc: EncoderOutput = model.apply(params, feats, masks, method=CaptionModel.encode)
     B = enc.memory.shape[0]
 
-    def step(state, _):
+    def step(state, t):
         carry, token, finished = state
         carry, logits = model.apply(
             params, carry, token, enc, method=CaptionModel.decode_step
         )
-        logits = forbid_special(logits)
+        logits = apply_min_len(forbid_special(logits), t, min_len)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
@@ -40,5 +41,5 @@ def greedy_decode(
         return (carry, nxt, finished), (nxt, lp)
 
     init = (enc.carry, jnp.full((B,), BOS_ID, jnp.int32), jnp.zeros((B,), bool))
-    _, (tokens, logprobs) = jax.lax.scan(step, init, None, length=T)
+    _, (tokens, logprobs) = jax.lax.scan(step, init, jnp.arange(T))
     return tokens.T, logprobs.T  # scan stacks on axis 0 -> [B, T]

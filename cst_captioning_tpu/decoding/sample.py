@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from cst_captioning_tpu.config.config import BOS_ID
-from cst_captioning_tpu.decoding.common import forbid_special, step_outputs
+from cst_captioning_tpu.decoding.common import apply_min_len, forbid_special, step_outputs
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
 
 
@@ -29,6 +29,7 @@ def sample_decode(
     num_rollouts: int = 1,
     temperature: float = 1.0,
     max_len: int | None = None,
+    min_len: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """-> (tokens [K, B, T], logprobs [K, B, T]); PAD/0 after EOS.
 
@@ -45,7 +46,7 @@ def sample_decode(
             carry, logits = model.apply(
                 params, carry, token, enc, method=CaptionModel.decode_step
             )
-            logits = forbid_special(logits)
+            logits = apply_min_len(forbid_special(logits), t, min_len)
             step_rng = jax.random.fold_in(k_rng, t)
             nxt = jax.random.categorical(step_rng, logits / temperature, axis=-1)
             nxt = nxt.astype(jnp.int32)

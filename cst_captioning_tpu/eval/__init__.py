@@ -1,0 +1,5 @@
+"""Evaluation harness (reference ``test.py``, SURVEY.md §3.3)."""
+
+from cst_captioning_tpu.eval.evaluator import Evaluator, evaluate_split
+
+__all__ = ["Evaluator", "evaluate_split"]

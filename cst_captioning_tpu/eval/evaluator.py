@@ -1,0 +1,84 @@
+"""Beam-search evaluation + COCO-style metric report (BASELINE config 5).
+
+Reference flow (SURVEY.md §3.3): load checkpoint -> beam=5 decode the split ->
+ids->words -> PTB tokenize -> BLEU/METEOR/ROUGE-L/CIDEr -> results json. Here
+the decode is one jitted fixed-shape program per batch and the metrics are the
+pure-Python scorers; results keep a schema in the reference's spirit:
+``{"captions": {vid: text}, "metrics": {...}}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from cst_captioning_tpu.config.config import EvalConfig
+from cst_captioning_tpu.data.batcher import Batcher
+from cst_captioning_tpu.data.dataset import CaptionDataset
+from cst_captioning_tpu.decoding import beam_search, greedy_decode
+from cst_captioning_tpu.metrics.scorer import CaptionScorer
+from cst_captioning_tpu.train.steps import batch_arrays
+
+
+class Evaluator:
+    def __init__(
+        self,
+        model,
+        dataset: CaptionDataset,
+        cfg: EvalConfig | None = None,
+        batch_size: int = 32,
+    ):
+        self.model = model
+        self.ds = dataset
+        self.cfg = cfg or EvalConfig()
+        self.batcher = Batcher(
+            dataset, batch_size=batch_size, max_len=self.cfg.max_len, mode="video"
+        )
+        W, T, lp = self.cfg.beam_size, self.cfg.max_len, self.cfg.length_penalty
+        ml = self.cfg.min_len
+
+        if W > 1:
+            self._decode = jax.jit(
+                lambda p, f, m: beam_search(
+                    model, p, f, m, beam_size=W, max_len=T, min_len=ml,
+                    length_penalty=lp,
+                )[0]
+            )
+        else:
+            self._decode = jax.jit(
+                lambda p, f, m: greedy_decode(model, p, f, m, max_len=T, min_len=ml)[0]
+            )
+
+    def generate(self, params) -> dict[str, str]:
+        """Decode every video of the split -> {video_id: caption string}."""
+        out: dict[str, str] = {}
+        for batch in self.batcher.epoch(shuffle=False):
+            feats, masks, *_ = batch_arrays(batch)
+            tokens = np.asarray(self._decode(params, feats, masks))
+            for i, ok in enumerate(batch.valid):
+                if ok:
+                    out[batch.video_ids[i]] = self.ds.vocab.decode(tokens[i])
+        return out
+
+    def evaluate(self, params, results_json: str = "") -> dict[str, Any]:
+        """generate + score; optionally write the results json."""
+        captions = self.generate(params)
+        gts = {vid: list(caps) for vid, caps in self.ds.gts_pool().items()}
+        res = {vid: [captions[vid]] for vid in captions}
+        scorer = CaptionScorer(metrics=self.cfg.metrics)
+        metrics = scorer.score(gts, res)
+        result = {"split": self.ds.split, "metrics": metrics, "captions": captions}
+        if results_json:
+            os.makedirs(os.path.dirname(results_json) or ".", exist_ok=True)
+            with open(results_json, "w") as f:
+                json.dump(result, f, indent=2, default=float)
+        return result
+
+
+def evaluate_split(model, params, dataset, cfg: EvalConfig | None = None,
+                   batch_size: int = 32, results_json: str = "") -> dict[str, Any]:
+    return Evaluator(model, dataset, cfg, batch_size).evaluate(params, results_json)

@@ -1,0 +1,26 @@
+"""RL layer: consensus rewards + self-critical sequence training (CST).
+
+Rebuilds the reference's RL phase (SURVEY.md §3.2, BASELINE configs 3-4) as
+the two-dispatch TPU design of §7 step 5: one jitted program decodes the
+greedy baseline AND the K Monte-Carlo rollouts in a single launch; the host
+computes CIDEr-D(+BLEU4) consensus rewards with a precomputed train-split df;
+a second jitted program re-scores the sampled tokens differentiably and
+applies the REINFORCE update (with psum-DP over the mesh).
+"""
+
+from cst_captioning_tpu.rl.rewards import RewardComputer, scb_baseline
+from cst_captioning_tpu.rl.scst import (
+    SCSTTrainer,
+    make_rl_decode,
+    make_rl_update,
+    make_parallel_rl_update,
+)
+
+__all__ = [
+    "RewardComputer",
+    "scb_baseline",
+    "SCSTTrainer",
+    "make_rl_decode",
+    "make_rl_update",
+    "make_parallel_rl_update",
+]

@@ -1,0 +1,78 @@
+"""Consensus reward computation (host side, vectorized numpy).
+
+The reward of a sampled caption is scored against the video's FULL pool of
+ground-truth captions (the "consensus" of CST, paper §3.3): CIDEr-D with a
+precomputed train-split document frequency — exactly the reference's
+``CiderD(df=...)`` reward path — optionally mixed with sentence BLEU-4
+(BASELINE config 4: ``w_c·CIDErD + w_b·BLEU4``).
+
+Reference pools are pre-tokenized once at construction; per-step work is one
+pass over the decoded hypotheses.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from cst_captioning_tpu.data.vocab import Vocab
+from cst_captioning_tpu.metrics.bleu import Bleu
+from cst_captioning_tpu.metrics.cider import CiderD, CorpusDF
+
+
+class RewardComputer:
+    def __init__(
+        self,
+        vocab: Vocab,
+        gts_pool: Mapping[str, Sequence[str]],   # video_id -> tokenized GT strings
+        df: CorpusDF | None = None,
+        cider_weight: float = 1.0,
+        bleu_weight: float = 0.0,
+    ):
+        self.vocab = vocab
+        self.refs = {vid: [c.split() for c in caps] for vid, caps in gts_pool.items()}
+        if df is None:
+            df = CorpusDF.from_refs(list(self.refs.values()))
+        self.cider = CiderD(df=df)
+        self.bleu = Bleu(4) if bleu_weight != 0.0 else None
+        self.cider_weight = cider_weight
+        self.bleu_weight = bleu_weight
+
+    def __call__(
+        self, video_ids: Sequence[str], token_rows: np.ndarray
+    ) -> np.ndarray:
+        """Score decoded rows against their videos' consensus pools.
+
+        ``token_rows``: [N, T] int array (N = any multiple of len(video_ids);
+        rollout-major layouts flatten to rows with ``video_ids`` cycling).
+        Returns rewards [N] in CIDEr units (×10 scale, like the reference).
+        """
+        n = len(token_rows)
+        vids = [video_ids[i % len(video_ids)] for i in range(n)]
+        hyps = [self.vocab.decode(row).split() for row in token_rows]
+        gts = {str(i): self.refs[v] for i, v in enumerate(vids)}
+        res = {str(i): [hyps[i]] for i in range(n)}
+        _, cider_scores = self.cider.compute_score(gts, res)
+        rewards = self.cider_weight * np.asarray(cider_scores)
+        if self.bleu is not None:
+            bleu4 = np.array(
+                [self.bleu.sentence_bleu(hyps[i], gts[str(i)])[3] for i in range(n)]
+            )
+            # BLEU in [0,1] vs CIDEr's ×10 scale: match the reference's mixed
+            # reward by scaling BLEU4 ×10 so the weights act on like scales
+            rewards = rewards + self.bleu_weight * bleu4 * 10.0
+        return rewards.astype(np.float32)
+
+
+def scb_baseline(rewards_kb: np.ndarray) -> np.ndarray:
+    """Self-consensus baseline (CST_MS_SCB, paper §3.4).
+
+    ``rewards_kb``: [K, B] rollout rewards. Baseline for rollout k is the mean
+    reward of the OTHER K-1 rollouts of the same video; K=1 degrades to 0.
+    """
+    K = rewards_kb.shape[0]
+    if K < 2:
+        return np.zeros_like(rewards_kb)
+    total = rewards_kb.sum(axis=0, keepdims=True)
+    return (total - rewards_kb) / (K - 1)

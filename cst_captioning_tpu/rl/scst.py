@@ -1,0 +1,201 @@
+"""SCST orchestration: fused decode dispatch -> host reward -> REINFORCE update.
+
+The throughput-critical path (SURVEY.md §3.2, the north-star metric). Design
+vs the reference's per-batch host↔device ping-pong:
+
+1. ``make_rl_decode``   — ONE jitted program produces the greedy baseline
+   decode AND all K multinomial rollouts, sharing the encoder pass (the
+   reference runs two separate ``model.sample`` calls).
+2. Host: ``RewardComputer`` scores rollouts + greedy against the consensus
+   pools (vectorized numpy, precomputed df); advantage = reward − baseline
+   (greedy SCST or self-consensus SCB).
+3. ``make_rl_update``   — second jitted program teacher-forces the sampled
+   tokens to get *differentiable* logprobs and applies the REINFORCE grad
+   (psum-DP in the parallel variant).
+
+Two dispatches, not ``io_callback``, exactly per SURVEY.md §7 step 5: the
+reward stays debuggable on host, the device work stays fused.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cst_captioning_tpu.config.config import RLConfig
+from cst_captioning_tpu.decoding import greedy_decode, sample_decode
+from cst_captioning_tpu.decoding.common import mask_from_tokens
+from cst_captioning_tpu.losses import reinforce_loss, sequence_log_probs
+from cst_captioning_tpu.rl.rewards import RewardComputer, scb_baseline
+from cst_captioning_tpu.train.state import TrainState
+
+
+def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
+                   max_len: int | None = None) -> Callable:
+    """Jitted: (params, feats, masks, rng) -> (greedy [B,T], samples [K,B,T])."""
+
+    @jax.jit
+    def decode(params, feats, masks, rng):
+        greedy, _ = greedy_decode(model, params, feats, masks, max_len=max_len)
+        samples, _ = sample_decode(
+            model, params, feats, masks, rng,
+            num_rollouts=num_rollouts, temperature=temperature, max_len=max_len,
+        )
+        return greedy, samples
+
+    return decode
+
+
+def _rl_loss_sums(model, params, feats, masks, tokens_flat, advantage_flat,
+                  valid_flat):
+    """(numerator, denominator) of REINFORCE loss over flattened rollouts.
+
+    ``valid_flat`` zeroes wrap-padded duplicate rows from short final batches
+    so they carry no gradient weight and don't dilute the normalization.
+    """
+    logits = model.apply(params, feats, masks, tokens_flat)
+    logp = sequence_log_probs(logits, tokens_flat)
+    mask = mask_from_tokens(tokens_flat) * valid_flat[:, None]
+    den = jnp.sum(mask)
+    num = reinforce_loss(logp, mask, advantage_flat) * jnp.maximum(den, 1.0)
+    return num, den
+
+
+def _tile_feats(feats, masks, K):
+    """[B, ...] -> [K*B, ...] (rollout-major tiling to match samples.reshape)."""
+    t = lambda x: jnp.tile(x, (K,) + (1,) * (x.ndim - 1))
+    return (
+        {k: t(v) for k, v in feats.items()},
+        {k: t(v) for k, v in masks.items()},
+    )
+
+
+def make_rl_update(model) -> Callable:
+    """Jitted: (state, feats, masks, samples [K,B,T], adv [K,B]) -> (state, metrics)."""
+
+    @jax.jit
+    def update(state: TrainState, feats, masks, samples, advantage, valid):
+        K, B, T = samples.shape
+        feats_f, masks_f = _tile_feats(feats, masks, K)
+        tokens = samples.reshape(K * B, T)
+        adv = advantage.reshape(K * B)
+        valid_f = jnp.tile(valid, (K,))
+
+        def loss_fn(p):
+            num, den = _rl_loss_sums(
+                model, p, feats_f, masks_f, tokens, adv, valid_f
+            )
+            return num / jnp.maximum(den, 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        gnorm = optax.global_norm(grads)
+        state = state.apply_gradients(grads)
+        return state, {"rl_loss": loss, "grad_norm": gnorm}
+
+    return update
+
+
+def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data") -> Callable:
+    """shard_map variant: batch axis sharded, exact global normalization."""
+
+    def device_update(state, feats, masks, samples, advantage, valid):
+        K, Bl, T = samples.shape
+        feats_f, masks_f = _tile_feats(feats, masks, K)
+        tokens = samples.reshape(K * Bl, T)
+        adv = advantage.reshape(K * Bl)
+        valid_f = jnp.tile(valid, (K,))
+
+        def local_num(p):
+            return _rl_loss_sums(model, p, feats_f, masks_f, tokens, adv, valid_f)
+
+        (num, den), grads_num = jax.value_and_grad(local_num, has_aux=True)(
+            state.params
+        )
+        den_total = jax.lax.psum(den, axis)
+        loss = jax.lax.psum(num, axis) / jnp.maximum(den_total, 1.0)
+        grads = jax.tree.map(
+            lambda g: jax.lax.psum(g, axis) / jnp.maximum(den_total, 1.0),
+            grads_num,
+        )
+        gnorm = optax.global_norm(grads)
+        state = state.apply_gradients(grads)
+        return state, {"rl_loss": loss, "grad_norm": gnorm}
+
+    sharded = jax.shard_map(
+        device_update,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(None, axis), P(None, axis), P(axis)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded)
+
+
+class SCSTTrainer:
+    """Per-batch CST step: decode -> consensus reward -> REINFORCE update.
+
+    ``baseline``: 'greedy' (SCST / CST_GT_None), 'scb' (self-consensus across
+    the other K-1 rollouts, CST_MS_SCB), or 'none'.
+    """
+
+    def __init__(
+        self,
+        model,
+        reward: RewardComputer,
+        cfg: RLConfig,
+        mesh: Mesh | None = None,
+        max_len: int | None = None,
+    ):
+        self.model = model
+        self.reward = reward
+        self.cfg = cfg
+        self.decode = make_rl_decode(
+            model, cfg.num_rollouts, cfg.temperature, max_len
+        )
+        self.update = (
+            make_parallel_rl_update(model, mesh) if mesh is not None
+            else make_rl_update(model)
+        )
+
+    def train_step(self, state: TrainState, feats, masks, video_ids, rng,
+                   valid=None):
+        K = self.cfg.num_rollouts
+        greedy, samples = self.decode(state.params, feats, masks, rng)
+
+        # host side: decode ids -> strings -> consensus rewards
+        samples_np = np.asarray(samples)                     # [K, B, T]
+        B = samples_np.shape[1]
+        valid_np = (
+            np.ones((B,), np.float32) if valid is None
+            else np.asarray(valid, np.float32)
+        )
+        r_samples = self.reward(video_ids, samples_np.reshape(K * B, -1))
+        r_kb = r_samples.reshape(K, B)
+
+        if self.cfg.baseline == "greedy":
+            r_greedy = self.reward(video_ids, np.asarray(greedy))
+            baseline = np.broadcast_to(r_greedy[None, :], (K, B))
+        elif self.cfg.baseline == "scb":
+            baseline = scb_baseline(r_kb)
+        elif self.cfg.baseline == "none":
+            baseline = np.zeros_like(r_kb)
+        else:
+            raise ValueError(f"unknown baseline {self.cfg.baseline!r}")
+
+        advantage = jnp.asarray((r_kb - baseline) * valid_np[None, :], jnp.float32)
+        state, metrics = self.update(
+            state, feats, masks, samples, advantage, jnp.asarray(valid_np)
+        )
+        metrics = dict(metrics)
+        n_valid = max(valid_np.sum(), 1.0)
+        v = valid_np[None, :]
+        metrics["reward_mean"] = float((r_kb * v).sum() / (K * n_valid))
+        metrics["reward_std"] = float(r_kb[:, valid_np > 0].std()) if n_valid else 0.0
+        metrics["baseline_mean"] = float((np.asarray(baseline) * v).sum() / (K * n_valid))
+        metrics["advantage_mean"] = float(np.asarray(advantage).sum() / (K * n_valid))
+        return state, metrics

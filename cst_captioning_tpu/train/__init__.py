@@ -1,0 +1,31 @@
+"""Training layer: TrainState, jitted steps, device-mesh data parallelism.
+
+Rebuilds the reference's ``train.py`` loop machinery (SURVEY.md §2 row 7) the
+TPU way: the whole optimization step — forward, loss, backward, grad clip,
+allreduce, param update — is ONE jitted XLA program per phase, sharded over a
+``jax.sharding.Mesh`` with explicit ``psum`` collectives riding ICI
+(replacing ``torch.nn.DataParallel``/NCCL, SURVEY.md §2 parallelism table).
+"""
+
+from cst_captioning_tpu.train.state import TrainState, create_train_state
+from cst_captioning_tpu.train.schedule import make_lr_schedule, make_optimizer
+from cst_captioning_tpu.train.mesh import (
+    make_mesh,
+    shard_batch,
+    replicate,
+    batch_sharding,
+)
+from cst_captioning_tpu.train.steps import make_xe_step, make_parallel_xe_step
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_lr_schedule",
+    "make_optimizer",
+    "make_mesh",
+    "shard_batch",
+    "replicate",
+    "batch_sharding",
+    "make_xe_step",
+    "make_parallel_xe_step",
+]

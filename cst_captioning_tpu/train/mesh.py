@@ -1,0 +1,43 @@
+"""Device mesh + sharding helpers (the NCCL/DataParallel replacement).
+
+One ``Mesh`` axis ``'data'`` for v1 (the reference is pure data-parallel,
+SURVEY.md §2 parallelism table). Axis naming leaves room for a future
+``('dcn', 'data')`` multi-host hierarchy without changing call sites.
+
+Batches shard along axis 0 across ``'data'``; params/state replicate.
+``shard_batch``/``replicate`` place host arrays accordingly so jitted steps
+see committed, correctly-laid-out inputs (no implicit transfers inside the
+step).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(num_devices: int = 0, axis: str = "data") -> Mesh:
+    devices = jax.devices()
+    if num_devices:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Axis-0 sharding for batch pytrees."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch, axis: str = "data"):
+    """Place a host batch pytree with axis 0 split across the mesh."""
+    return jax.device_put(batch, batch_sharding(mesh, axis))
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate a pytree (params / train state) on every mesh device."""
+    return jax.device_put(tree, replicated_sharding(mesh))

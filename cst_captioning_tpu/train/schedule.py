@@ -1,0 +1,45 @@
+"""LR schedules + optimizer assembly (reference ``opts.py`` LR flags).
+
+The reference decays LR by a factor every N epochs and clips grads by global
+norm (SURVEY.md §3.1); expressed here as an optax chain so it lives inside
+the jitted step.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from cst_captioning_tpu.config.config import TrainConfig
+
+
+def make_lr_schedule(cfg: TrainConfig, steps_per_epoch: int) -> optax.Schedule:
+    """Step-wise exponential decay: lr * decay^(epoch // decay_every)."""
+    if cfg.lr_decay_every <= 0 or cfg.lr_decay >= 1.0:
+        return optax.constant_schedule(cfg.lr)
+    return optax.exponential_decay(
+        init_value=cfg.lr,
+        transition_steps=cfg.lr_decay_every * max(steps_per_epoch, 1),
+        decay_rate=cfg.lr_decay,
+        staircase=True,
+    )
+
+
+def make_optimizer(
+    cfg: TrainConfig, steps_per_epoch: int, lr_override: float | None = None
+) -> optax.GradientTransformation:
+    lr = (
+        optax.constant_schedule(lr_override)
+        if lr_override is not None
+        else make_lr_schedule(cfg, steps_per_epoch)
+    )
+    opt = {
+        "adam": optax.adam,
+        "adamw": lambda l: optax.adamw(l, weight_decay=cfg.weight_decay),
+        "sgd": optax.sgd,
+        "rmsprop": optax.rmsprop,
+    }
+    if cfg.optimizer not in opt:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}; have {sorted(opt)}")
+    chain = [optax.clip_by_global_norm(cfg.grad_clip)] if cfg.grad_clip > 0 else []
+    chain.append(opt[cfg.optimizer](lr))
+    return optax.chain(*chain)

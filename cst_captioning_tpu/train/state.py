@@ -1,0 +1,54 @@
+"""TrainState: params + optimizer state + step + RNG, one pytree.
+
+The reference scatters this across the torch module, the optimizer object and
+an ``infos`` pickle (SURVEY.md §3.5); here it is a single flax.struct pytree
+so the whole training state shards/replicates/checkpoints as one unit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray                 # scalar int32
+    params: Any
+    opt_state: Any
+    rng: jax.Array                    # base RNG key (folded per step/device)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            opt_state=new_opt_state,
+        )
+
+
+def create_train_state(
+    model,
+    tx: optax.GradientTransformation,
+    sample_batch: tuple,
+    seed: int = 0,
+) -> TrainState:
+    """Initialize params from a sample (feats, masks, labels) batch."""
+    feats, masks, labels = sample_batch
+    rng = jax.random.key(seed)
+    init_rng, state_rng = jax.random.split(rng)
+    params = model.init(init_rng, feats, masks, labels)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        rng=state_rng,
+        tx=tx,
+        apply_fn=model.apply,
+    )

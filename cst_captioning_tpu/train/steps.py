@@ -1,0 +1,118 @@
+"""Jitted XE train steps: single-device and mesh-parallel (shard_map).
+
+The whole reference inner loop — forward, masked (weighted) XE, backward,
+global-norm clip, allreduce, Adam update (SURVEY.md §3.1) — compiles to one
+XLA program. Data parallelism is explicit shard_map over ``Mesh('data')``:
+
+- the batch arrives sharded on axis 0 (``shard_batch``), params replicated,
+- each device computes grads of its *local loss numerator* (sum of per-token
+  losses) plus its local token count,
+- one ``psum`` over 'data' reduces both; grads divide by the GLOBAL token
+  count, so the parallel step is bit-comparable to the single-device step on
+  the concatenated batch (asserted by the 8-fake-device test, SURVEY.md §4
+  item 4) — not just approximately data-parallel,
+- the update then runs identically on every device, keeping state replicated
+  without a broadcast.
+
+RNG: dropout key = fold_in(fold_in(state.rng, step), device_index) — distinct
+per step and per shard, reproducible under resharding.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cst_captioning_tpu.losses import masked_cross_entropy
+from cst_captioning_tpu.train.state import TrainState
+
+
+def _local_loss_sums(model, params, feats, masks, labels, mask, weights,
+                     dropout_rng, label_smoothing):
+    """(numerator, denominator) of the masked XE on this shard."""
+    logits = model.apply(
+        params, feats, masks, labels, train=True, rngs={"dropout": dropout_rng}
+    )
+    w_mask = mask * weights[:, None]
+    den = jnp.sum(w_mask)
+    # masked_cross_entropy normalizes internally; recover the sum form so the
+    # global normalization can happen after the cross-device reduce
+    num = masked_cross_entropy(
+        logits, labels, mask, weights=weights, label_smoothing=label_smoothing
+    ) * den
+    return num, den
+
+
+def make_xe_step(model, label_smoothing: float = 0.0):
+    """Single-device jitted step: (state, batch arrays) -> (state, metrics)."""
+
+    @jax.jit
+    def step(state: TrainState, feats, masks, labels, mask, weights):
+        drng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(p):
+            num, den = _local_loss_sums(
+                model, p, feats, masks, labels, mask, weights, drng, label_smoothing
+            )
+            return num / jnp.maximum(den, 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        gnorm = optax.global_norm(grads)
+        state = state.apply_gradients(grads)
+        return state, {"loss": loss, "grad_norm": gnorm}
+
+    return step
+
+
+def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
+                          axis: str = "data"):
+    """shard_map data-parallel step, exact-equivalent to the fused batch."""
+
+    def device_step(state: TrainState, feats, masks, labels, mask, weights):
+        drng = jax.random.fold_in(
+            jax.random.fold_in(state.rng, state.step), jax.lax.axis_index(axis)
+        )
+
+        def local_num(p):
+            num, den = _local_loss_sums(
+                model, p, feats, masks, labels, mask, weights, drng, label_smoothing
+            )
+            return num, den
+
+        (num, den), grads_num = jax.value_and_grad(local_num, has_aux=True)(
+            state.params
+        )
+        den_total = jax.lax.psum(den, axis)
+        num_total = jax.lax.psum(num, axis)
+        grads = jax.tree.map(
+            lambda g: jax.lax.psum(g, axis) / jnp.maximum(den_total, 1.0),
+            grads_num,
+        )
+        loss = num_total / jnp.maximum(den_total, 1.0)
+        gnorm = optax.global_norm(grads)
+        state = state.apply_gradients(grads)
+        return state, {"loss": loss, "grad_norm": gnorm}
+
+    sharded = jax.shard_map(
+        device_step,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded)
+
+
+def batch_arrays(batch) -> tuple[Any, ...]:
+    """Batch -> (feats, masks, labels, mask, weights) jnp pytrees."""
+    return (
+        {k: jnp.asarray(v) for k, v in batch.feats.items()},
+        {k: jnp.asarray(v) for k, v in batch.feat_masks.items()},
+        jnp.asarray(batch.labels),
+        jnp.asarray(batch.mask),
+        jnp.asarray(batch.weights),
+    )

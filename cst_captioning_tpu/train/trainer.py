@@ -1,0 +1,245 @@
+"""Experiment driver: XE phase, CST/RL phase, validation, checkpointing.
+
+The orchestration layer of the reference's ``train.py`` (SURVEY.md §3.1-3.2,
+§3.5): epoch loop -> jitted steps -> per-epoch greedy validation scored by
+CIDEr-D -> best/latest checkpoints -> optional resume -> XE->RL handoff.
+
+Device placement: with a multi-device mesh the step is the shard_map-parallel
+variant and batches are placed sharded; single device uses the plain jitted
+step. Host batch prep overlaps device compute via the prefetch thread.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from cst_captioning_tpu.ckpt import CheckpointManager, load_params
+from cst_captioning_tpu.config.config import ExperimentConfig
+from cst_captioning_tpu.data.batcher import Batcher
+from cst_captioning_tpu.data.dataset import CaptionDataset
+from cst_captioning_tpu.data.prefetch import prefetch_to_device
+from cst_captioning_tpu.eval.evaluator import Evaluator
+from cst_captioning_tpu.metrics.cider import CorpusDF
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.rl import RewardComputer, SCSTTrainer
+from cst_captioning_tpu.train.mesh import batch_sharding, make_mesh, replicate
+from cst_captioning_tpu.train.schedule import make_optimizer
+from cst_captioning_tpu.train.state import TrainState, create_train_state
+from cst_captioning_tpu.train.steps import batch_arrays, make_parallel_xe_step, make_xe_step
+from cst_captioning_tpu.utils.logging import EventLogger, StepTimer
+
+
+class Trainer:
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        train_ds: CaptionDataset,
+        val_ds: CaptionDataset | None = None,
+        log_path: str = "",
+        use_mesh: bool | None = None,
+    ):
+        self.cfg = cfg
+        self.train_ds = train_ds
+        self.val_ds = val_ds
+        self.model = CaptionModel(cfg.model)
+        self.log = EventLogger(log_path)
+
+        n_dev = cfg.mesh.num_devices or len(jax.devices())
+        self.use_mesh = (n_dev > 1) if use_mesh is None else use_mesh
+        self.mesh = make_mesh(cfg.mesh.num_devices) if self.use_mesh else None
+
+        self.batcher = Batcher(
+            train_ds,
+            batch_size=cfg.data.batch_size,
+            max_len=cfg.model.max_len,
+            mode="caption",
+            seq_per_vid=cfg.data.seq_per_vid,
+            seed=cfg.data.shuffle_seed,
+        )
+        self.steps_per_epoch = self.batcher.num_batches()
+        tx = make_optimizer(cfg.train, self.steps_per_epoch)
+        sample = next(iter(self.batcher.epoch(shuffle=False)))
+        feats, masks, labels, *_ = batch_arrays(sample)
+        self.state = create_train_state(
+            self.model, tx, (feats, masks, labels), seed=cfg.train.seed
+        )
+        if self.mesh is not None:
+            self.state = replicate(self.mesh, self.state)
+            self.xe_step = make_parallel_xe_step(
+                self.model, self.mesh, cfg.train.label_smoothing
+            )
+        else:
+            self.xe_step = make_xe_step(self.model, cfg.train.label_smoothing)
+
+        self.ckpt = CheckpointManager(cfg.train.ckpt_dir, metric="CIDEr-D")
+        self.epoch = 0
+        if cfg.train.resume:
+            self._resume()
+
+        self.validator = (
+            Evaluator(
+                self.model,
+                val_ds,
+                cfg.eval.__class__(beam_size=1, max_len=cfg.model.max_len,
+                                   metrics=("CIDEr-D",)),
+                batch_size=cfg.data.batch_size,
+            )
+            if val_ds is not None
+            else None
+        )
+
+    # ---- resume / handoff --------------------------------------------------
+
+    def _resume(self):
+        # resume="auto": newest valid ckpt in this run's ckpt_dir;
+        # resume=<dir>: explicit checkpoint directory (latest/best inside it)
+        resume = self.cfg.train.resume
+        src_dir = self.cfg.train.ckpt_dir if resume == "auto" else resume
+        mgr = self.ckpt if resume == "auto" else CheckpointManager(src_dir)
+        restored = mgr.restore_latest(jax.device_get(self.state))
+        if restored is None:
+            self.log.log("resume_not_found", dir=src_dir)
+            return
+        state, infos = restored
+        self.state = (
+            replicate(self.mesh, state) if self.mesh is not None else state
+        )
+        self.epoch = int(infos.get("epoch", 0))
+        self.log.log("resume", dir=src_dir, step=int(state.step), epoch=self.epoch)
+
+    def load_params_from(self, ckpt_dir: str, name: str = "best"):
+        """XE -> RL handoff: params only, fresh optimizer (SURVEY.md §5)."""
+        params = load_params(ckpt_dir, name, jax.device_get(self.state.params))
+        self.state = self.state.replace(params=params)
+        if self.mesh is not None:
+            self.state = replicate(self.mesh, self.state)
+        self.log.log("handoff", source=f"{ckpt_dir}/{name}")
+
+    # ---- phases ------------------------------------------------------------
+
+    def _device_batches(self, batcher: Batcher):
+        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
+        yield from prefetch_to_device(
+            batcher.epoch(),
+            size=self.cfg.data.prefetch,
+            sharding=sharding,
+            # valid rides along so wrap-padded duplicate rows get zero weight
+            transform=lambda b: batch_arrays(b)
+            + (jax.numpy.asarray(b.valid, jax.numpy.float32),),
+        )
+
+    def train_xe(self, epochs: int | None = None) -> float | None:
+        """Cross-entropy (XE/WXE) phase; returns last validation CIDEr-D."""
+        cfg = self.cfg
+        epochs = epochs if epochs is not None else cfg.train.epochs
+        timer = StepTimer()
+        last_val = None
+        weighted = cfg.train.loss == "wxe"
+        first_step = True
+        for _ in range(epochs):
+            timer.reset()
+            losses = []
+            for arrays in self._device_batches(self.batcher):
+                feats, masks, labels, mask, weights, valid = arrays
+                # invalid rows get zero weight -> excluded from loss + norm
+                weights = valid if not weighted else weights * valid
+                self.state, m = self.xe_step(
+                    self.state, feats, masks, labels, mask, weights
+                )
+                losses.append(float(m["loss"]))
+                if first_step:
+                    # exclude jit-compile time from the throughput meter
+                    first_step = False
+                    timer.reset()
+                else:
+                    timer.tick(cfg.data.batch_size)
+            self.epoch += 1
+            self.log.log(
+                "xe_epoch",
+                epoch=self.epoch,
+                loss=float(np.mean(losses)),
+                clips_per_sec=timer.clips_per_sec,
+            )
+            last_val = self._validate_and_checkpoint()
+        return last_val
+
+    def train_rl(self, epochs: int | None = None) -> float | None:
+        """CST/RL phase (SCST or consensus-CST per cfg.rl)."""
+        cfg = self.cfg
+        epochs = epochs if epochs is not None else cfg.rl.epochs
+        # fresh optimizer at RL LR (handoff semantics)
+        tx = make_optimizer(cfg.train, self.steps_per_epoch, lr_override=cfg.rl.lr)
+        self.state = self.state.replace(
+            step=jax.numpy.zeros((), jax.numpy.int32), opt_state=tx.init(
+                jax.device_get(self.state.params)
+            ), tx=tx,
+        )
+        if self.mesh is not None:
+            self.state = replicate(self.mesh, self.state)
+
+        # df=None lets RewardComputer build the train-pool df itself
+        df = CorpusDF.load(cfg.data.cider_df) if cfg.data.cider_df else None
+        reward = RewardComputer(
+            self.train_ds.vocab,
+            self.train_ds.gts_pool(),
+            df=df,
+            cider_weight=cfg.rl.reward_cider_weight,
+            bleu_weight=cfg.rl.reward_bleu4_weight,
+        )
+        scst = SCSTTrainer(
+            self.model, reward, cfg.rl, mesh=self.mesh, max_len=cfg.model.max_len
+        )
+        rl_batcher = Batcher(
+            self.train_ds,
+            batch_size=cfg.data.batch_size,
+            max_len=cfg.model.max_len,
+            mode="video",
+            seed=cfg.data.shuffle_seed,
+        )
+        rng = jax.random.key(cfg.train.seed + 1)
+        timer = StepTimer()
+        last_val = None
+        first_step = True
+        for _ in range(epochs):
+            timer.reset()
+            rewards = []
+            for batch in rl_batcher.epoch(shuffle=True):
+                feats, masks, *_ = batch_arrays(batch)
+                rng, step_rng = jax.random.split(rng)
+                self.state, m = scst.train_step(
+                    self.state, feats, masks, batch.video_ids, step_rng,
+                    valid=batch.valid,
+                )
+                rewards.append(m["reward_mean"])
+                if first_step:
+                    first_step = False
+                    timer.reset()
+                else:
+                    timer.tick(cfg.data.batch_size)
+            self.epoch += 1
+            self.log.log(
+                "rl_epoch",
+                epoch=self.epoch,
+                reward=float(np.mean(rewards)),
+                clips_per_sec=timer.clips_per_sec,
+            )
+            last_val = self._validate_and_checkpoint()
+        return last_val
+
+    # ---- validation --------------------------------------------------------
+
+    def _validate_and_checkpoint(self) -> float | None:
+        value = None
+        if self.validator is not None and (
+            self.epoch % self.cfg.train.eval_every_epochs == 0
+        ):
+            result = self.validator.evaluate(self.state.params)
+            value = result["metrics"].get("CIDEr-D")
+            self.log.log("validate", epoch=self.epoch, cider_d=value)
+        is_best = self.ckpt.save(
+            jax.device_get(self.state), value, infos={"epoch": self.epoch}
+        )
+        if is_best:
+            self.log.log("new_best", epoch=self.epoch, cider_d=value)
+        return value

@@ -1,0 +1,5 @@
+"""Utilities: structured logging, timing (reference ``utils.py``, row 13)."""
+
+from cst_captioning_tpu.utils.logging import EventLogger, StepTimer
+
+__all__ = ["EventLogger", "StepTimer"]

@@ -1,0 +1,58 @@
+"""Structured JSONL event log + console echo (SURVEY.md §5 observability row).
+
+The reference logs via stdout prints and a history pickle; here every event is
+one JSON line (step, phase, loss, reward stats, CIDEr, clips/sec/chip) so runs
+are machine-parseable, plus a human-readable console echo.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, IO
+
+
+class EventLogger:
+    def __init__(self, path: str = "", echo: bool = True):
+        self._fh: IO | None = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+        self.echo = echo
+
+    def log(self, event: str, **fields: Any) -> None:
+        rec = {"ts": time.time(), "event": event, **fields}
+        if self._fh:
+            self._fh.write(json.dumps(rec, default=float) + "\n")
+        if self.echo:
+            kv = " ".join(
+                f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in fields.items()
+            )
+            print(f"[{event}] {kv}", file=sys.stderr)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class StepTimer:
+    """Running clips/sec meter (the north-star throughput counter)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = time.perf_counter()
+        self._clips = 0
+
+    def tick(self, clips: int):
+        self._clips += clips
+
+    @property
+    def clips_per_sec(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self._clips / dt if dt > 0 else 0.0

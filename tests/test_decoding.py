@@ -179,3 +179,13 @@ def test_beam_return_all_sorted(setup):
     assert tokens.shape == (B, 4, T) and scores.shape == (B, 4)
     s = np.asarray(scores)
     assert np.all(np.diff(s, axis=1) <= 1e-6)  # descending
+
+
+def test_min_len_suppresses_early_eos(setup):
+    model, params, feats, masks = setup
+    tg, _ = greedy_decode(model, params, feats, masks, min_len=3)
+    tb, _ = beam_search(model, params, feats, masks, beam_size=3, min_len=3)
+    for tokens in (np.asarray(tg), np.asarray(tb)):
+        lengths = (tokens != PAD_ID).sum(axis=1)
+        assert (lengths >= 3).all(), tokens
+        assert not (tokens[:, :2] == EOS_ID).any()

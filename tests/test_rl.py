@@ -1,0 +1,181 @@
+"""RL tests: consensus rewards, SCB baseline, SCST learning on a rigged reward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config.config import EOS_ID, ModelConfig, RLConfig, TrainConfig
+from cst_captioning_tpu.data.vocab import Vocab
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.rl import (
+    RewardComputer,
+    SCSTTrainer,
+    make_parallel_rl_update,
+    make_rl_update,
+    scb_baseline,
+)
+from cst_captioning_tpu.train import create_train_state, make_mesh, make_optimizer, replicate, shard_batch
+
+V = 14
+WORDS = [f"w{i}" for i in range(V - 4)]
+
+
+def make_vocab():
+    return Vocab.from_corpus_words(WORDS)
+
+
+def test_reward_computer_prefers_matching_captions():
+    vocab = make_vocab()
+    gts = {"v0": ["w0 w1 w2", "w0 w1 w3"], "v1": ["w5 w6", "w5 w6 w7"]}
+    rc = RewardComputer(vocab, gts)
+    rows = np.asarray(
+        [
+            vocab.encode("w0 w1 w2".split()) + [EOS_ID],
+            vocab.encode("w5 w6 w7".split()) + [EOS_ID],
+        ],
+        np.int32,
+    )
+    r = rc(["v0", "v1"], rows)
+    assert r.shape == (2,) and (r > 0).all()
+    # swapping hyps across videos must tank the reward
+    r_swapped = rc(["v1", "v0"], rows)
+    assert r_swapped[0] < r[0] and r_swapped[1] < r[1]
+
+
+def test_reward_computer_rollout_major_cycling():
+    vocab = make_vocab()
+    gts = {"v0": ["w0 w1"], "v1": ["w5 w6"]}
+    rc = RewardComputer(vocab, gts)
+    row_v0 = vocab.encode(["w0", "w1"]) + [EOS_ID]
+    row_v1 = vocab.encode(["w5", "w6"]) + [EOS_ID]
+    # K=2 rollouts, B=2: rows [r0v0, r0v1, r1v0, r1v1]
+    rows = np.asarray([row_v0, row_v1, row_v0, row_v1], np.int32)
+    r = rc(["v0", "v1"], rows)
+    assert r[0] == pytest.approx(r[2]) and r[1] == pytest.approx(r[3])
+    assert (r > 0).all()
+
+
+def test_reward_computer_bleu_mix_changes_scores():
+    vocab = make_vocab()
+    gts = {"v0": ["w0 w1 w2 w3 w4"]}
+    rc_c = RewardComputer(vocab, gts, cider_weight=1.0, bleu_weight=0.0)
+    rc_m = RewardComputer(vocab, gts, cider_weight=1.0, bleu_weight=0.5)
+    row = np.asarray([vocab.encode("w0 w1 w2 w3 w4".split()) + [EOS_ID]], np.int32)
+    assert rc_m(["v0"], row)[0] > rc_c(["v0"], row)[0]
+
+
+def test_reward_empty_hypothesis_is_zero():
+    vocab = make_vocab()
+    rc = RewardComputer(vocab, {"v0": ["w0 w1"]})
+    r = rc(["v0"], np.zeros((1, 5), np.int32))  # all PAD
+    assert r[0] == 0.0
+
+
+def test_scb_baseline_leave_one_out():
+    r = np.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])  # [K=3, B=2]
+    b = scb_baseline(r)
+    np.testing.assert_allclose(b[0], [(3 + 5) / 2, (4 + 6) / 2])
+    np.testing.assert_allclose(b[1], [(1 + 5) / 2, (2 + 6) / 2])
+    # K=1 -> zero baseline
+    np.testing.assert_allclose(scb_baseline(np.ones((1, 4))), 0.0)
+
+
+@pytest.fixture(scope="module")
+def model_setup():
+    B, F, T = 8, 3, 5
+    cfg = ModelConfig(
+        vocab_size=V,
+        modalities=(("resnet", 6),),
+        d_embed=12,
+        d_hidden=12,
+        d_att=6,
+        encoder="meanpool",
+        dropout=0.0,
+        max_len=T,
+        max_frames=F,
+        dtype="float32",
+    )
+    model = CaptionModel(cfg)
+    rng = np.random.default_rng(0)
+    feats = {"resnet": jnp.asarray(rng.normal(size=(B, F, 6)), jnp.float32)}
+    masks = {"resnet": jnp.ones((B, F), jnp.float32)}
+    labels = jnp.asarray(rng.integers(4, V, size=(B, T)), jnp.int32)
+    tx = make_optimizer(TrainConfig(lr=5e-2, grad_clip=5.0), 10)
+    state = create_train_state(model, tx, (feats, masks, labels), seed=1)
+    return model, state, feats, masks
+
+
+class TokenReward:
+    """Rigged reward: +1 per occurrence of a target token (RewardComputer API)."""
+
+    def __init__(self, target: int):
+        self.target = target
+
+    def __call__(self, video_ids, rows):
+        return (np.asarray(rows) == self.target).sum(axis=1).astype(np.float32)
+
+
+@pytest.mark.parametrize("baseline", ["greedy", "scb", "none"])
+def test_scst_learns_rigged_reward(model_setup, baseline):
+    """A few SCST steps must raise the frequency of the rewarded token."""
+    model, state, feats, masks = model_setup
+    cfg = RLConfig(enabled=True, num_rollouts=4, baseline=baseline, temperature=1.0)
+    trainer = SCSTTrainer(model, TokenReward(target=7), cfg)
+    vids = [f"v{i}" for i in range(8)]
+    rng = jax.random.key(0)
+    rewards = []
+    for i in range(15):
+        rng, step_rng = jax.random.split(rng)
+        state, m = trainer.train_step(state, feats, masks, vids, step_rng)
+        rewards.append(m["reward_mean"])
+    assert rewards[-1] > rewards[0] + 0.5, f"{baseline}: {rewards[0]:.2f}->{rewards[-1]:.2f}"
+
+
+def test_parallel_rl_update_matches_single(model_setup):
+    model, state, feats, masks = model_setup
+    mesh = make_mesh()
+    K, B, T = 3, 8, 5
+    rng = np.random.default_rng(3)
+    samples = jnp.asarray(rng.integers(2, V, size=(K, B, T)), jnp.int32)
+    adv = jnp.asarray(rng.normal(size=(K, B)), jnp.float32)
+
+    valid = jnp.ones((B,), jnp.float32)
+    s_state, s_m = make_rl_update(model)(state, feats, masks, samples, adv, valid)
+    p_state, p_m = make_parallel_rl_update(model, mesh)(
+        replicate(mesh, state),
+        *shard_batch(mesh, (feats, masks)),
+        jax.device_put(samples, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(None, "data"))),
+        jax.device_put(adv, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(None, "data"))),
+        shard_batch(mesh, valid),
+    )
+    np.testing.assert_allclose(float(s_m["rl_loss"]), float(p_m["rl_loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_state.params),
+        jax.tree_util.tree_leaves(p_state.params),
+    ):
+        # lr=5e-2 + Adam rsqrt amplifies psum float reassociation; a real
+        # normalization bug would be O(1) off, so 1e-2 still discriminates
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=2e-3)
+
+
+def test_train_step_zero_weights_invalid_rows(model_setup):
+    """Wrap-padded rows (valid=False) must not change the update."""
+    model, state, feats, masks = model_setup
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="none")
+    trainer = SCSTTrainer(model, TokenReward(target=7), cfg)
+    vids = [f"v{i}" for i in range(8)]
+    rng = jax.random.key(5)
+    valid = np.asarray([1, 1, 1, 1, 0, 0, 0, 0], np.float32)
+    s1, m1 = trainer.train_step(state, feats, masks, vids, rng, valid=valid)
+    # metrics only reflect valid rows
+    rows_r = TokenReward(7)(vids, np.zeros((16, 5)))
+    assert np.isfinite(m1["reward_mean"])
+    # gradient from invalid rows is excluded: corrupting their features
+    # must not change the resulting params
+    feats2 = {k: v.at[4:].set(99.0) for k, v in feats.items()}
+    s2, m2 = trainer.train_step(state, feats2, masks, vids, rng, valid=valid)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

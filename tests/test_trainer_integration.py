@@ -1,0 +1,144 @@
+"""Trainer integration: overfit XE, RL improves reward, resume, handoff.
+
+SURVEY.md §4 item 3: overfit a handful of synthetic clips with XE, then show
+the CST phase lifts the consensus reward; plus checkpoint/resume round-trips
+through the Trainer.
+"""
+
+import dataclasses
+import glob
+import json
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config.config import (
+    DataConfig,
+    EvalConfig,
+    ExperimentConfig,
+    ModelConfig,
+    RLConfig,
+    TrainConfig,
+)
+from cst_captioning_tpu.data import CaptionDataset, make_synthetic_dataset
+from cst_captioning_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def synth_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("trainsynth")
+    return make_synthetic_dataset(
+        str(out),
+        num_videos=16,
+        num_topics=3,
+        vocab_words=24,
+        modalities={"resnet": 24},
+        max_frames=4,
+        seed=11,
+    )
+
+
+def make_cfg(ckpt_dir: str, vocab_size: int, **rl_kw) -> ExperimentConfig:
+    return ExperimentConfig(
+        name="itest",
+        model=ModelConfig(
+            vocab_size=vocab_size,
+            modalities=(("resnet", 24),),
+            d_embed=24,
+            d_hidden=24,
+            d_att=12,
+            encoder="temporal_attention",
+            dropout=0.0,
+            max_len=10,
+            max_frames=4,
+            dtype="float32",
+        ),
+        data=DataConfig(batch_size=8, seq_per_vid=3),
+        train=TrainConfig(
+            lr=5e-3, epochs=12, grad_clip=5.0, ckpt_dir=ckpt_dir,
+            eval_every_epochs=4, seed=0,
+        ),
+        rl=RLConfig(enabled=True, num_rollouts=3, lr=1e-3, epochs=4, **rl_kw),
+        eval=EvalConfig(beam_size=1, max_len=10),
+    )
+
+
+@pytest.fixture(scope="module")
+def datasets(synth_dir):
+    train = CaptionDataset(synth_dir["info_json"], {"resnet": synth_dir["resnet"]},
+                           "train", 4)
+    val = CaptionDataset(synth_dir["info_json"], {"resnet": synth_dir["resnet"]},
+                         "val", 4)
+    return train, val
+
+
+def test_xe_overfit_then_rl_improves(datasets, tmp_path_factory):
+    train_ds, val_ds = datasets
+    ckpt_dir = str(tmp_path_factory.mktemp("ckpt"))
+    log_path = ckpt_dir + "/events.jsonl"
+    cfg = make_cfg(ckpt_dir, len(train_ds.vocab), baseline="greedy")
+    # single-device trainer (mesh path covered by step-level tests)
+    tr = Trainer(cfg, train_ds, val_ds, log_path=log_path, use_mesh=False)
+
+    tr.train_xe()
+    events = [json.loads(l) for l in open(log_path)]
+    xe_losses = [e["loss"] for e in events if e["event"] == "xe_epoch"]
+    assert xe_losses[-1] < xe_losses[0] * 0.75, "XE phase did not learn"
+    vals = [e["cider_d"] for e in events if e["event"] == "validate"]
+    assert vals, "validation never ran"
+    assert (tmp_path_factory.getbasetemp() / "").exists()
+
+    rl_val = tr.train_rl()
+    events = [json.loads(l) for l in open(log_path)]
+    rl_rewards = [e["reward"] for e in events if e["event"] == "rl_epoch"]
+    assert len(rl_rewards) == cfg.rl.epochs
+    assert rl_rewards[-1] > rl_rewards[0], (
+        f"CST reward did not improve: {rl_rewards}"
+    )
+    # checkpoints on disk
+    assert glob.glob(ckpt_dir + "/best/state.msgpack")
+    assert glob.glob(ckpt_dir + "/latest/state.msgpack")
+
+
+def test_trainer_resume_continues_epoch(datasets, tmp_path_factory):
+    train_ds, val_ds = datasets
+    ckpt_dir = str(tmp_path_factory.mktemp("ckpt2"))
+    cfg = make_cfg(ckpt_dir, len(train_ds.vocab))
+    cfg = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, epochs=2))
+    tr1 = Trainer(cfg, train_ds, val_ds, use_mesh=False)
+    tr1.train_xe()
+    step1 = int(tr1.state.step)
+
+    cfg_resume = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, resume="auto")
+    )
+    tr2 = Trainer(cfg_resume, train_ds, val_ds, use_mesh=False)
+    assert tr2.epoch == 2
+    assert int(tr2.state.step) == step1
+
+
+def test_xe_to_rl_handoff_loads_params(datasets, tmp_path_factory):
+    train_ds, val_ds = datasets
+    src_dir = str(tmp_path_factory.mktemp("ckpt3"))
+    cfg = make_cfg(src_dir, len(train_ds.vocab))
+    cfg = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(cfg.train, epochs=1, eval_every_epochs=1),
+    )
+    tr1 = Trainer(cfg, train_ds, val_ds, use_mesh=False)
+    tr1.train_xe()
+
+    dst_dir = str(tmp_path_factory.mktemp("ckpt4"))
+    cfg2 = make_cfg(dst_dir, len(train_ds.vocab))
+    tr2 = Trainer(cfg2, train_ds, val_ds, use_mesh=False)
+    before = jax_leaf_sum(tr2.state.params)
+    tr2.load_params_from(src_dir, "best")
+    after = jax_leaf_sum(tr2.state.params)
+    assert before != after
+    np.testing.assert_allclose(after, jax_leaf_sum(tr1.state.params), rtol=1e-6)
+
+
+def jax_leaf_sum(tree):
+    import jax
+
+    return float(sum(np.abs(np.asarray(x)).sum() for x in jax.tree_util.tree_leaves(tree)))
