@@ -1,0 +1,71 @@
+"""Build + load the creward shared library (ctypes; no pybind11 needed).
+
+Compiles ``creward.cpp`` with g++ on first use into the package directory and
+memoizes the handle. Every failure path (no compiler, compile error, load
+error) returns None so callers fall back to the pure-Python scorer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "creward.cpp")
+_LIB = os.path.join(_DIR, "libcreward.so")
+
+_lock = threading.Lock()
+_cached: "ctypes.CDLL | None | bool" = False  # False = not attempted yet
+
+
+def _compile() -> bool:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        _SRC, "-o", _LIB,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+        return proc.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.crw_create.restype = ctypes.c_void_p
+    lib.crw_create.argtypes = [ctypes.c_double, ctypes.c_double,
+                               ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
+    lib.crw_free.argtypes = [ctypes.c_void_p]
+    lib.crw_set_df.argtypes = [ctypes.c_void_p, i32p, i32p, f64p, ctypes.c_int64]
+    lib.crw_add_video.restype = ctypes.c_int32
+    lib.crw_add_video.argtypes = [ctypes.c_void_p, i32p, i32p, ctypes.c_int32]
+    lib.crw_score.argtypes = [
+        ctypes.c_void_p, i32p, i32p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int32, f32p,
+    ]
+    return lib
+
+
+def load_creward() -> "ctypes.CDLL | None":
+    """Load (building if needed) the reward kernel; None -> use Python path."""
+    global _cached
+    with _lock:
+        if _cached is not False:
+            return _cached
+        lib = None
+        try:
+            if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            ):
+                if not _compile():
+                    _cached = None
+                    return None
+            lib = _bind(ctypes.CDLL(_LIB))
+        except OSError:
+            lib = None
+        _cached = lib
+        return lib

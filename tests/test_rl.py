@@ -179,3 +179,71 @@ def test_train_step_zero_weights_invalid_rows(model_setup):
         jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s2.params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fast_reward_matches_cider_oracle():
+    """Cached-ref reward path must reproduce metrics.cider.CiderD exactly."""
+    from cst_captioning_tpu.metrics.cider import CiderD, CorpusDF
+
+    rng = np.random.default_rng(0)
+    vocab = make_vocab()
+    vids = [f"v{i}" for i in range(6)]
+    gts = {
+        v: [
+            " ".join(rng.choice(WORDS, size=rng.integers(3, 9)))
+            for _ in range(4)
+        ]
+        for v in vids
+    }
+    refs = {v: [c.split() for c in caps] for v, caps in gts.items()}
+    df = CorpusDF.from_refs(list(refs.values()))
+    rc = RewardComputer(vocab, gts, df=df, cider_weight=1.0, bleu_weight=0.0)
+
+    rows = np.asarray(
+        [
+            vocab.encode(list(rng.choice(WORDS, size=rng.integers(2, 8)))) + [EOS_ID]
+            + [0] * 10
+            for _ in range(12)
+        ][0:12],
+        dtype=object,
+    )
+    rows = np.stack([np.asarray((list(r) + [0] * 12)[:12], np.int32) for r in rows])
+    got = rc(vids, rows)
+
+    oracle = CiderD(df=df)
+    hyps = [vocab.decode(r).split() for r in rows]
+    o_gts = {str(i): refs[vids[i % 6]] for i in range(12)}
+    o_res = {str(i): [hyps[i]] for i in range(12)}
+    _, want = oracle.compute_score(o_gts, o_res)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-8)
+
+
+def test_fast_reward_matches_bleu_oracle():
+    from cst_captioning_tpu.metrics.bleu import Bleu
+    from cst_captioning_tpu.metrics.cider import CorpusDF
+
+    rng = np.random.default_rng(1)
+    vocab = make_vocab()
+    vids = ["a", "b"]
+    gts = {
+        v: [" ".join(rng.choice(WORDS, size=rng.integers(4, 9))) for _ in range(3)]
+        for v in vids
+    }
+    refs = {v: [c.split() for c in caps] for v, caps in gts.items()}
+    df = CorpusDF.from_refs(list(refs.values()))
+    rc_mixed = RewardComputer(vocab, gts, df=df, cider_weight=0.0, bleu_weight=1.0)
+    rows = np.stack(
+        [
+            np.asarray(
+                (vocab.encode(list(rng.choice(WORDS, size=6))) + [EOS_ID] + [0] * 10)[:10],
+                np.int32,
+            )
+            for _ in range(8)
+        ]
+    )
+    got = rc_mixed(vids, rows)
+    oracle = Bleu(4)
+    for i in range(8):
+        hyp = vocab.decode(rows[i]).split()
+        want = oracle.sentence_bleu(hyp, refs[vids[i % 2]])[3] * 10.0
+        np.testing.assert_allclose(got[i], want, rtol=1e-6)
