@@ -22,17 +22,29 @@ def prefetch_to_device(
     size: int = 2,
     sharding: Any | None = None,
     transform: Callable[[Any], Any] | None = None,
+    place: bool = True,
 ) -> Iterator[Any]:
     """Iterate ``it``, staging ``size`` elements ahead onto device.
 
     ``transform`` runs on the host thread before the transfer (e.g. Batch ->
     device-ready pytree); ``sharding`` is forwarded to ``jax.device_put`` so
     multi-device layouts are materialized without a separate reshard.
+
+    ``place=False`` skips the internal ``device_put`` — for items that mix
+    device arrays with host-only leaves (e.g. video-id strings for the RL
+    reward), ``transform`` does its own placement of the array part.
     """
+    if not place:
+        _place = lambda x: x
+    elif sharding is not None:
+        _place = lambda x: jax.device_put(x, sharding)
+    else:
+        _place = jax.device_put
+
     if size < 1:
         for x in it:
             x = transform(x) if transform is not None else x
-            yield jax.device_put(x, sharding) if sharding is not None else jax.device_put(x)
+            yield _place(x)
         return
 
     q: queue.Queue = queue.Queue(maxsize=size)
@@ -54,7 +66,7 @@ def prefetch_to_device(
         try:
             for x in it:
                 x = transform(x) if transform is not None else x
-                x = jax.device_put(x, sharding) if sharding is not None else jax.device_put(x)
+                x = _place(x)
                 if not _put(x):
                     return  # consumer gone: drop staged work, free buffers
         except BaseException as e:  # propagate into the consumer

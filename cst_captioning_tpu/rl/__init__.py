@@ -12,6 +12,7 @@ from cst_captioning_tpu.rl.rewards import RewardComputer, scb_baseline
 from cst_captioning_tpu.rl.scst import (
     SCSTTrainer,
     make_rl_decode,
+    make_parallel_rl_decode,
     make_rl_update,
     make_parallel_rl_update,
 )
@@ -21,6 +22,7 @@ __all__ = [
     "scb_baseline",
     "SCSTTrainer",
     "make_rl_decode",
+    "make_parallel_rl_decode",
     "make_rl_update",
     "make_parallel_rl_update",
 ]

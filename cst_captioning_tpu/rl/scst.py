@@ -52,6 +52,42 @@ def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
     return decode
 
 
+def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
+                            temperature: float = 1.0,
+                            max_len: int | None = None,
+                            axis: str = "data") -> Callable:
+    """shard_map decode: batch sharded over the mesh, the dominant RL cost
+    scales with chips (SURVEY.md §3.2/§7 step 6) instead of running on one.
+
+    Decode has no cross-example interaction, so each device decodes its own
+    batch shard. The greedy path is deterministic — sharded output equals the
+    single-device decode of the concatenated batch (pinned by
+    tests/test_rl.py). Sampling folds ``axis_index`` into the rollout key so
+    shards draw independent streams.
+    """
+
+    def device_decode(params, feats, masks, rng):
+        local_rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        greedy, _ = greedy_decode(model, params, feats, masks, max_len=max_len)
+        samples, _ = sample_decode(
+            model, params, feats, masks, local_rng,
+            num_rollouts=num_rollouts, temperature=temperature, max_len=max_len,
+        )
+        return greedy, samples
+
+    sharded = jax.shard_map(
+        device_decode,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P()),
+        out_specs=(P(axis), P(None, axis)),
+        # decode is collective-free (purely per-shard); the varying-axis type
+        # check would otherwise reject the scan carry whose init (BOS tokens)
+        # is device-invariant while the looped carry varies with the shard
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def _rl_loss_sums(model, params, feats, masks, tokens_flat, advantage_flat,
                   valid_flat):
     """(numerator, denominator) of REINFORCE loss over flattened rollouts.
@@ -141,6 +177,13 @@ class SCSTTrainer:
 
     ``baseline``: 'greedy' (SCST / CST_GT_None), 'scb' (self-consensus across
     the other K-1 rollouts, CST_MS_SCB), or 'none'.
+
+    With a mesh, BOTH dispatches are shard_map-parallel — decode (the dominant
+    cost) and update shard the batch over 'data'; host reward stays per-host.
+
+    :meth:`train_step` is the strict sequential step. :meth:`train_epoch`
+    is the pipelined loop (SURVEY.md §7 "hard parts"): the host scores batch
+    *i* while the device decodes batch *i+1*.
     """
 
     def __init__(
@@ -154,26 +197,24 @@ class SCSTTrainer:
         self.model = model
         self.reward = reward
         self.cfg = cfg
-        self.decode = make_rl_decode(
-            model, cfg.num_rollouts, cfg.temperature, max_len
-        )
-        self.update = (
-            make_parallel_rl_update(model, mesh) if mesh is not None
-            else make_rl_update(model)
-        )
+        self.mesh = mesh
+        if mesh is not None:
+            self.decode = make_parallel_rl_decode(
+                model, mesh, cfg.num_rollouts, cfg.temperature, max_len
+            )
+            self.update = make_parallel_rl_update(model, mesh)
+        else:
+            self.decode = make_rl_decode(
+                model, cfg.num_rollouts, cfg.temperature, max_len
+            )
+            self.update = make_rl_update(model)
 
-    def train_step(self, state: TrainState, feats, masks, video_ids, rng,
-                   valid=None):
+    # ---- reward / advantage (host) ------------------------------------------
+
+    def _advantage(self, greedy, samples_np, video_ids, valid_np):
+        """-> (advantage [K,B] np, metrics dict). Blocks on decode transfer."""
         K = self.cfg.num_rollouts
-        greedy, samples = self.decode(state.params, feats, masks, rng)
-
-        # host side: decode ids -> strings -> consensus rewards
-        samples_np = np.asarray(samples)                     # [K, B, T]
         B = samples_np.shape[1]
-        valid_np = (
-            np.ones((B,), np.float32) if valid is None
-            else np.asarray(valid, np.float32)
-        )
         r_samples = self.reward(video_ids, samples_np.reshape(K * B, -1))
         r_kb = r_samples.reshape(K, B)
 
@@ -187,15 +228,83 @@ class SCSTTrainer:
         else:
             raise ValueError(f"unknown baseline {self.cfg.baseline!r}")
 
-        advantage = jnp.asarray((r_kb - baseline) * valid_np[None, :], jnp.float32)
-        state, metrics = self.update(
-            state, feats, masks, samples, advantage, jnp.asarray(valid_np)
-        )
-        metrics = dict(metrics)
+        advantage = (r_kb - baseline) * valid_np[None, :]
         n_valid = max(valid_np.sum(), 1.0)
         v = valid_np[None, :]
-        metrics["reward_mean"] = float((r_kb * v).sum() / (K * n_valid))
-        metrics["reward_std"] = float(r_kb[:, valid_np > 0].std()) if n_valid else 0.0
-        metrics["baseline_mean"] = float((np.asarray(baseline) * v).sum() / (K * n_valid))
-        metrics["advantage_mean"] = float(np.asarray(advantage).sum() / (K * n_valid))
+        metrics = {
+            "reward_mean": float((r_kb * v).sum() / (K * n_valid)),
+            "reward_std": (
+                float(r_kb[:, valid_np > 0].std()) if valid_np.sum() > 0 else 0.0
+            ),
+            "baseline_mean": float((np.asarray(baseline) * v).sum() / (K * n_valid)),
+            "advantage_mean": float(advantage.sum() / (K * n_valid)),
+        }
+        return advantage, metrics
+
+    def _finish(self, state, greedy, samples, feats, masks, video_ids, valid_np):
+        """Score a decoded batch and apply the REINFORCE update."""
+        samples_np = np.asarray(samples)                     # [K, B, T]
+        advantage, host_metrics = self._advantage(
+            greedy, samples_np, video_ids, valid_np
+        )
+        state, metrics = self.update(
+            state, feats, masks, samples,
+            jnp.asarray(advantage, jnp.float32), jnp.asarray(valid_np),
+        )
+        metrics = dict(metrics)
+        metrics.update(host_metrics)
         return state, metrics
+
+    @staticmethod
+    def _valid_np(valid, B):
+        return (
+            np.ones((B,), np.float32) if valid is None
+            else np.asarray(valid, np.float32)
+        )
+
+    # ---- strict sequential step ---------------------------------------------
+
+    def train_step(self, state: TrainState, feats, masks, video_ids, rng,
+                   valid=None):
+        greedy, samples = self.decode(state.params, feats, masks, rng)
+        valid_np = self._valid_np(valid, samples.shape[1])
+        return self._finish(
+            state, greedy, samples, feats, masks, video_ids, valid_np
+        )
+
+    # ---- pipelined epoch ----------------------------------------------------
+
+    def train_epoch(self, state: TrainState, batches, rng, on_step=None):
+        """Pipelined SCST over an epoch of batches.
+
+        ``batches`` yields ``(feats, masks, video_ids, valid)`` with arrays
+        already on device. Decode for batch *i+1* is dispatched before the
+        update for batch *i*, so the device decodes *i+1* while the host
+        scores *i* (JAX async dispatch orders them on the device stream).
+        The decoded policy is therefore one update stale — the standard
+        async-SCST trade; with the RL learning rate (~2e-5) the policy drift
+        per step is negligible, and the REINFORCE logprobs are recomputed
+        from the *current* params in the update, so the gradient estimator
+        itself stays well-formed.
+
+        Returns ``(state, metrics_list)``; ``on_step(metrics)`` fires per batch.
+        """
+        pending = None
+        out = []
+        for feats, masks, video_ids, valid in batches:
+            rng, srng = jax.random.split(rng)
+            decoded = self.decode(state.params, feats, masks, srng)
+            if pending is not None:
+                state, m = self._finish(state, *pending)
+                out.append(m)
+                if on_step is not None:
+                    on_step(m)
+            greedy, samples = decoded
+            valid_np = self._valid_np(valid, samples.shape[1])
+            pending = (greedy, samples, feats, masks, video_ids, valid_np)
+        if pending is not None:
+            state, m = self._finish(state, *pending)
+            out.append(m)
+            if on_step is not None:
+                on_step(m)
+        return state, out

@@ -129,6 +129,26 @@ class Trainer:
             + (jax.numpy.asarray(b.valid, jax.numpy.float32),),
         )
 
+    def _rl_device_batches(self, batcher: Batcher):
+        """Prefetched RL batches: arrays staged to device (sharded when a mesh
+        is in play), video ids + valid mask staying host-side for the reward."""
+        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
+
+        def transform(b):
+            feats, masks, *_ = batch_arrays(b)
+            if sharding is not None:
+                feats, masks = jax.device_put((feats, masks), sharding)
+            else:
+                feats, masks = jax.device_put((feats, masks))
+            return (feats, masks, b.video_ids, b.valid)
+
+        yield from prefetch_to_device(
+            batcher.epoch(shuffle=True),
+            size=self.cfg.data.prefetch,
+            transform=transform,
+            place=False,
+        )
+
     def train_xe(self, epochs: int | None = None) -> float | None:
         """Cross-entropy (XE/WXE) phase; returns last validation CIDEr-D."""
         cfg = self.cfg
@@ -200,23 +220,26 @@ class Trainer:
         rng = jax.random.key(cfg.train.seed + 1)
         timer = StepTimer()
         last_val = None
-        first_step = True
         for _ in range(epochs):
             timer.reset()
             rewards = []
-            for batch in rl_batcher.epoch(shuffle=True):
-                feats, masks, *_ = batch_arrays(batch)
-                rng, step_rng = jax.random.split(rng)
-                self.state, m = scst.train_step(
-                    self.state, feats, masks, batch.video_ids, step_rng,
-                    valid=batch.valid,
-                )
+
+            def on_step(m):
                 rewards.append(m["reward_mean"])
-                if first_step:
-                    first_step = False
-                    timer.reset()
+                if len(rewards) == 1:
+                    timer.reset()  # exclude jit-compile time of the first step
                 else:
                     timer.tick(cfg.data.batch_size)
+
+            # pipelined epoch: host reward for batch i overlaps device decode
+            # of batch i+1; batches are prefetched to device by a host thread
+            rng, ep_rng = jax.random.split(rng)
+            self.state, _ = scst.train_epoch(
+                self.state,
+                self._rl_device_batches(rl_batcher),
+                ep_rng,
+                on_step=on_step,
+            )
             self.epoch += 1
             self.log.log(
                 "rl_epoch",
