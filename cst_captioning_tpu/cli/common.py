@@ -27,6 +27,17 @@ def add_common_args(p: argparse.ArgumentParser) -> None:
         help="config override, repeatable (e.g. train__epochs=10)",
     )
     p.add_argument("--log-jsonl", default="", help="structured event log path")
+    p.add_argument(
+        "--profile",
+        default="",
+        metavar="DIR",
+        help="write a jax.profiler trace of training steps to DIR",
+    )
+    p.add_argument(
+        "--debug-nans",
+        action="store_true",
+        help="enable the jax_debug_nans sanitizer (raises at the first NaN)",
+    )
 
 
 def parse_overrides(pairs: list[str]) -> dict:
@@ -45,6 +56,10 @@ def parse_overrides(pairs: list[str]) -> dict:
 def load_config(args: argparse.Namespace) -> ExperimentConfig:
     cfg = get_preset(args.preset)
     overrides = parse_overrides(args.set)
+    if getattr(args, "profile", ""):
+        overrides["train__profile_dir"] = args.profile
+    if getattr(args, "debug_nans", False):
+        overrides["train__debug_nans"] = True
     if overrides:
         cfg = cfg.override(**overrides)
     return cfg

@@ -15,6 +15,7 @@ from cst_captioning_tpu.cli.common import add_common_args, load_config, open_dat
 from cst_captioning_tpu.ckpt import load_params
 from cst_captioning_tpu.eval.evaluator import evaluate_split
 from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.train.mesh import make_mesh, replicate
 from cst_captioning_tpu.train.steps import batch_arrays
 from cst_captioning_tpu.data.batcher import Batcher
 
@@ -41,9 +42,17 @@ def main(argv: list[str] | None = None) -> None:
     template = model.init(jax.random.key(0), feats, masks, labels)
     params = load_params(args.ckpt_dir, args.ckpt_name, template)
 
+    # shard the decode over all visible devices (batch must divide evenly)
+    n_dev = cfg.mesh.num_devices or len(jax.devices())
+    mesh = None
+    if n_dev > 1 and cfg.data.batch_size % n_dev == 0:
+        mesh = make_mesh(cfg.mesh.num_devices)
+        params = replicate(mesh, params)
+
     result = evaluate_split(
         model, params, ds, cfg.eval,
         batch_size=cfg.data.batch_size, results_json=args.results_json,
+        mesh=mesh,
     )
     print(json.dumps(result["metrics"], indent=2, default=float))
 

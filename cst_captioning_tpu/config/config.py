@@ -110,6 +110,10 @@ class TrainConfig:
     eval_every_epochs: int = 1
     ckpt_dir: str = "checkpoints"
     resume: str = ""                    # "", "auto", or explicit ckpt path
+    # observability (SURVEY.md §5 rows 1-2)
+    profile_dir: str = ""               # jax.profiler trace output dir ("" = off)
+    profile_steps: int = 10             # steps to trace (after the compile step)
+    debug_nans: bool = False            # jax_debug_nans sanitizer mode
 
 
 @dataclass(frozen=True)

@@ -15,26 +15,43 @@ from typing import Any
 
 import jax
 import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
 
 from cst_captioning_tpu.config.config import EvalConfig
 from cst_captioning_tpu.data.batcher import Batcher
 from cst_captioning_tpu.data.dataset import CaptionDataset
 from cst_captioning_tpu.decoding import beam_search, greedy_decode
 from cst_captioning_tpu.metrics.scorer import CaptionScorer
+from cst_captioning_tpu.train.mesh import batch_sharding
 from cst_captioning_tpu.train.steps import batch_arrays
 
 
 class Evaluator:
+    """With a ``mesh``, the decode is shard_map-parallel: every device
+    beam-decodes its batch shard, and the generated token ids are gathered
+    back to the host when the global output array is read (the SURVEY.md §5
+    dist-comm row's eval-time gather). ``valid``-row filtering is unchanged,
+    so multi-device eval produces the exact single-device captions (pinned
+    by tests/test_ckpt_eval.py)."""
+
     def __init__(
         self,
         model,
         dataset: CaptionDataset,
         cfg: EvalConfig | None = None,
         batch_size: int = 32,
+        mesh: Mesh | None = None,
     ):
         self.model = model
         self.ds = dataset
         self.cfg = cfg or EvalConfig()
+        self.mesh = mesh
+        if mesh is not None:
+            n = mesh.devices.size
+            if batch_size % n:
+                raise ValueError(
+                    f"batch_size {batch_size} not divisible by mesh size {n}"
+                )
         self.batcher = Batcher(
             dataset, batch_size=batch_size, max_len=self.cfg.max_len, mode="video"
         )
@@ -42,22 +59,33 @@ class Evaluator:
         ml = self.cfg.min_len
 
         if W > 1:
-            self._decode = jax.jit(
-                lambda p, f, m: beam_search(
-                    model, p, f, m, beam_size=W, max_len=T, min_len=ml,
-                    length_penalty=lp,
-                )[0]
-            )
+            decode = lambda p, f, m: beam_search(
+                model, p, f, m, beam_size=W, max_len=T, min_len=ml,
+                length_penalty=lp,
+            )[0]
         else:
-            self._decode = jax.jit(
-                lambda p, f, m: greedy_decode(model, p, f, m, max_len=T, min_len=ml)[0]
+            decode = lambda p, f, m: greedy_decode(
+                model, p, f, m, max_len=T, min_len=ml
+            )[0]
+        if mesh is not None:
+            decode = jax.shard_map(
+                decode,
+                mesh=mesh,
+                in_specs=(P(), P("data"), P("data")),
+                out_specs=P("data"),
+                # decode is collective-free; see make_parallel_rl_decode
+                check_vma=False,
             )
+        self._decode = jax.jit(decode)
 
     def generate(self, params) -> dict[str, str]:
         """Decode every video of the split -> {video_id: caption string}."""
         out: dict[str, str] = {}
+        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
         for batch in self.batcher.epoch(shuffle=False):
             feats, masks, *_ = batch_arrays(batch)
+            if sharding is not None:
+                feats, masks = jax.device_put((feats, masks), sharding)
             tokens = np.asarray(self._decode(params, feats, masks))
             for i, ok in enumerate(batch.valid):
                 if ok:
@@ -80,5 +108,8 @@ class Evaluator:
 
 
 def evaluate_split(model, params, dataset, cfg: EvalConfig | None = None,
-                   batch_size: int = 32, results_json: str = "") -> dict[str, Any]:
-    return Evaluator(model, dataset, cfg, batch_size).evaluate(params, results_json)
+                   batch_size: int = 32, results_json: str = "",
+                   mesh: Mesh | None = None) -> dict[str, Any]:
+    return Evaluator(model, dataset, cfg, batch_size, mesh=mesh).evaluate(
+        params, results_json
+    )

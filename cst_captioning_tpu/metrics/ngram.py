@@ -1,7 +1,7 @@
 """N-gram counting shared by BLEU and CIDEr (reference: cider/'s precook).
 
 Hot host path during the RL phase: every sampled caption is cooked per step.
-A C++ fast path lives in ``cst_captioning_tpu.ops.native``; this module is the
+A C++ fast path lives in ``cst_captioning_tpu.native``; this module is the
 always-available pure-Python implementation and the correctness oracle.
 """
 

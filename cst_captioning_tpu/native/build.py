@@ -3,31 +3,55 @@
 Compiles ``creward.cpp`` with g++ on first use into the package directory and
 memoizes the handle. Every failure path (no compiler, compile error, load
 error) returns None so callers fall back to the pure-Python scorer.
+
+The binary name embeds a hash of the source (``libcreward-<sha>.so``), so a
+stale prebuilt library can never shadow newer source — git clones don't
+preserve mtimes, making mtime staleness checks unreliable. Binaries are never
+committed (.gitignore'd); the library is always built from source on the
+machine that uses it.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "creward.cpp")
-_LIB = os.path.join(_DIR, "libcreward.so")
 
 _lock = threading.Lock()
 _cached: "ctypes.CDLL | None | bool" = False  # False = not attempted yet
 
 
-def _compile() -> bool:
+def _lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_DIR, f"libcreward-{digest}.so")
+
+
+def _compile(lib_path: str) -> bool:
+    tmp = f"{lib_path}.{os.getpid()}.tmp"  # per-process: builders can't collide
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", _LIB,
+        _SRC, "-o", tmp,
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
-        return proc.returncode == 0
+        if proc.returncode != 0:
+            return False
+        # sweep dead binaries from previous source revisions
+        for old in os.listdir(_DIR):
+            if old.startswith("libcreward-") and old.endswith(".so"):
+                if os.path.join(_DIR, old) != lib_path:
+                    try:
+                        os.unlink(os.path.join(_DIR, old))
+                    except OSError:
+                        pass
+        os.replace(tmp, lib_path)  # atomic publish
+        return True
     except (OSError, subprocess.TimeoutExpired):
         return False
 
@@ -58,13 +82,12 @@ def load_creward() -> "ctypes.CDLL | None":
             return _cached
         lib = None
         try:
-            if not os.path.exists(_LIB) or (
-                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-            ):
-                if not _compile():
+            path = _lib_path()
+            if not os.path.exists(path):
+                if not _compile(path):
                     _cached = None
                     return None
-            lib = _bind(ctypes.CDLL(_LIB))
+            lib = _bind(ctypes.CDLL(path))
         except OSError:
             lib = None
         _cached = lib

@@ -134,7 +134,9 @@ def _bleu4_score(hyp: list[str], hyp_counts: Counter, stats: _RefStats) -> float
         else:
             p = (matched + 1.0) / (total + 1.0) if total else 0.0
         if p == 0.0:
-            return 0.0 if n == _MAX_N else 0.0
+            # only reachable at n=1 (higher orders are +1-smoothed): a
+            # hypothesis with zero unigram matches scores 0
+            return 0.0
         log_p += math.log(p)
         score = bp * math.exp(log_p / n)
     return score
@@ -294,9 +296,14 @@ class RewardComputer:
         import ctypes
         import os
 
-        # map ids out of the safe range (defensive) and through the intern lut
-        clipped = np.clip(token_rows, 0, len(self._lut) - 1)
-        interned = np.ascontiguousarray(self._lut[clipped])
+        from cst_captioning_tpu.config.config import UNK_ID
+
+        # ids outside the vocab (model vocab_size > len(vocab)) intern as
+        # '<unk>', matching Vocab.decode on the Python path
+        in_range = (token_rows >= 0) & (token_rows < len(self._lut))
+        interned = np.ascontiguousarray(
+            self._lut[np.where(in_range, token_rows, UNK_ID)]
+        )
         vidx = np.asarray(
             [self._video_index[video_ids[i % nv]] for i in range(n)], np.int32
         )

@@ -11,11 +11,13 @@ step. Host batch prep overlaps device compute via the prefetch thread.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 
 from cst_captioning_tpu.ckpt import CheckpointManager, load_params
-from cst_captioning_tpu.config.config import ExperimentConfig
+from cst_captioning_tpu.config.config import EvalConfig, ExperimentConfig
 from cst_captioning_tpu.data.batcher import Batcher
 from cst_captioning_tpu.data.dataset import CaptionDataset
 from cst_captioning_tpu.data.prefetch import prefetch_to_device
@@ -28,6 +30,7 @@ from cst_captioning_tpu.train.schedule import make_optimizer
 from cst_captioning_tpu.train.state import TrainState, create_train_state
 from cst_captioning_tpu.train.steps import batch_arrays, make_parallel_xe_step, make_xe_step
 from cst_captioning_tpu.utils.logging import EventLogger, StepTimer
+from cst_captioning_tpu.utils.profiling import StepProfiler
 
 
 class Trainer:
@@ -44,6 +47,10 @@ class Trainer:
         self.val_ds = val_ds
         self.model = CaptionModel(cfg.model)
         self.log = EventLogger(log_path)
+        if cfg.train.debug_nans:
+            # sanitizer mode (SURVEY.md §5 row 2): every jitted step re-runs
+            # eagerly on NaN production and raises at the originating op
+            jax.config.update("jax_debug_nans", True)
 
         n_dev = cfg.mesh.num_devices or len(jax.devices())
         self.use_mesh = (n_dev > 1) if use_mesh is None else use_mesh
@@ -81,9 +88,10 @@ class Trainer:
             Evaluator(
                 self.model,
                 val_ds,
-                cfg.eval.__class__(beam_size=1, max_len=cfg.model.max_len,
-                                   metrics=("CIDEr-D",)),
+                EvalConfig(beam_size=1, max_len=cfg.model.max_len,
+                           metrics=("CIDEr-D",)),
                 batch_size=cfg.data.batch_size,
+                mesh=self.mesh,
             )
             if val_ds is not None
             else None
@@ -154,6 +162,11 @@ class Trainer:
         cfg = self.cfg
         epochs = epochs if epochs is not None else cfg.train.epochs
         timer = StepTimer()
+        profiler = StepProfiler(
+            os.path.join(cfg.train.profile_dir, "xe") if cfg.train.profile_dir
+            else "",
+            cfg.train.profile_steps,
+        )
         last_val = None
         weighted = cfg.train.loss == "wxe"
         first_step = True
@@ -168,12 +181,14 @@ class Trainer:
                     self.state, feats, masks, labels, mask, weights
                 )
                 losses.append(float(m["loss"]))
+                profiler.tick()
                 if first_step:
                     # exclude jit-compile time from the throughput meter
                     first_step = False
                     timer.reset()
                 else:
                     timer.tick(cfg.data.batch_size)
+            profiler.stop()
             self.epoch += 1
             self.log.log(
                 "xe_epoch",
@@ -219,6 +234,11 @@ class Trainer:
         )
         rng = jax.random.key(cfg.train.seed + 1)
         timer = StepTimer()
+        profiler = StepProfiler(
+            os.path.join(cfg.train.profile_dir, "rl") if cfg.train.profile_dir
+            else "",
+            cfg.train.profile_steps,
+        )
         last_val = None
         for _ in range(epochs):
             timer.reset()
@@ -226,6 +246,7 @@ class Trainer:
 
             def on_step(m):
                 rewards.append(m["reward_mean"])
+                profiler.tick()
                 if len(rewards) == 1:
                     timer.reset()  # exclude jit-compile time of the first step
                 else:
@@ -240,6 +261,7 @@ class Trainer:
                 ep_rng,
                 on_step=on_step,
             )
+            profiler.stop()
             self.epoch += 1
             self.log.log(
                 "rl_epoch",

@@ -1,0 +1,49 @@
+"""jax.profiler trace capture around a window of training steps.
+
+The reference had no profiling at all (SURVEY.md §5 row 1: ad-hoc
+``time.time()`` prints); here a trace of N post-compile steps can be captured
+to a directory viewable in TensorBoard/Perfetto, wired through
+``TrainConfig.profile_dir`` / ``--profile``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+
+class StepProfiler:
+    """Start a trace at step ``skip`` (0-based), stop after ``steps`` more.
+
+    ``tick()`` is called once per finished training step; the first ``skip``
+    steps are excluded so jit compilation doesn't dominate the trace. Safe to
+    leave in hot loops when disabled (``out_dir=""`` -> every tick is a no-op).
+    """
+
+    def __init__(self, out_dir: str, steps: int = 10, skip: int = 1):
+        self.out_dir = out_dir
+        self.steps = steps
+        self.skip = skip
+        self._count = 0
+        self._active = False
+        self._done = not out_dir
+
+    def tick(self) -> None:
+        if self._done:
+            return
+        self._count += 1
+        if not self._active and self._count > self.skip:
+            jax.profiler.start_trace(self.out_dir)
+            self._active = True
+            self._stop_at = self._count + self.steps
+        elif self._active and self._count >= self._stop_at:
+            self.stop()
+
+    def stop(self) -> None:
+        """Finalize the trace (also called when an epoch ends mid-window)."""
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            print(f"[profile] trace written to {self.out_dir}", file=sys.stderr)
+        self._done = True

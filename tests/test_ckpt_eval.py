@@ -119,3 +119,27 @@ def test_evaluator_full_metric_table(eval_setup, tmp_path):
     assert (tmp_path / "res.json").exists()
     # untrained model on synthetic data: scores exist but are low
     assert 0.0 <= m["Bleu_4"] <= 1.0
+
+
+@pytest.mark.parametrize("beam", [1, 3])
+def test_evaluator_mesh_matches_single_device(eval_setup, beam):
+    """Sharded eval (8 fake devices) must produce the exact same captions."""
+    from cst_captioning_tpu.train import make_mesh, replicate
+
+    model, params, ds = eval_setup
+    cfg = EvalConfig(beam_size=beam, max_len=8)
+    single = Evaluator(model, ds, cfg, batch_size=8).generate(params)
+    mesh = make_mesh()
+    sharded = Evaluator(model, ds, cfg, batch_size=8, mesh=mesh).generate(
+        replicate(mesh, params)
+    )
+    assert sharded == single
+
+
+def test_evaluator_mesh_rejects_indivisible_batch(eval_setup):
+    from cst_captioning_tpu.train import make_mesh
+
+    model, params, ds = eval_setup
+    with pytest.raises(ValueError, match="not divisible"):
+        Evaluator(model, ds, EvalConfig(beam_size=1, max_len=8),
+                  batch_size=5, mesh=make_mesh())

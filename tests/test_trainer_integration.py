@@ -142,3 +142,53 @@ def jax_leaf_sum(tree):
     import jax
 
     return float(sum(np.abs(np.asarray(x)).sum() for x in jax.tree_util.tree_leaves(tree)))
+
+
+def test_profile_and_debug_nans_flags(datasets, tmp_path_factory):
+    """SURVEY.md §5 rows 1-2: jax.profiler trace + jax_debug_nans, wired
+    through TrainConfig and smoke-tested end to end."""
+    import os
+
+    import jax
+
+    train_ds, val_ds = datasets
+    ckpt_dir = str(tmp_path_factory.mktemp("ckptprof"))
+    prof_dir = str(tmp_path_factory.mktemp("trace"))
+    cfg = make_cfg(ckpt_dir, len(train_ds.vocab))
+    cfg = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(
+            cfg.train, epochs=1, profile_dir=prof_dir, profile_steps=2,
+            debug_nans=True,
+        ),
+        rl=dataclasses.replace(cfg.rl, epochs=1),
+    )
+    try:
+        tr = Trainer(cfg, train_ds, val_ds, use_mesh=False)
+        assert jax.config.jax_debug_nans, "debug_nans flag not applied"
+        tr.train_xe()
+        tr.train_rl()
+    finally:
+        jax.config.update("jax_debug_nans", False)
+    # both phase traces captured something
+    for phase in ("xe", "rl"):
+        d = os.path.join(prof_dir, phase)
+        files = [
+            os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs
+        ]
+        assert files, f"no {phase} profiler trace written under {d}"
+
+
+def test_cli_observability_flags_map_to_config():
+    import argparse
+
+    from cst_captioning_tpu.cli.common import add_common_args, load_config
+
+    p = argparse.ArgumentParser()
+    add_common_args(p)
+    args = p.parse_args(
+        ["--preset", "msvd_xe_meanpool", "--profile", "/tmp/tr", "--debug-nans"]
+    )
+    cfg = load_config(args)
+    assert cfg.train.profile_dir == "/tmp/tr"
+    assert cfg.train.debug_nans is True
