@@ -116,14 +116,16 @@ class CheckpointManager:
         Returns True when a new best was recorded.
         """
         infos = dict(infos or {})
+        improved = value is not None and self._improved(value)
+        if improved:
+            self.best_value = float(value)
+        # both checkpoints carry the post-update best so 'latest' metadata
+        # never lags 'best' (ADVICE r1)
         infos["best_value"] = self.best_value
         save_state(self.ckpt_dir, "latest", state, infos)
-        if value is not None and self._improved(value):
-            self.best_value = float(value)
-            infos["best_value"] = self.best_value
+        if improved:
             save_state(self.ckpt_dir, "best", state, infos)
-            return True
-        return False
+        return improved
 
     def restore_latest(self, template: TrainState) -> tuple[TrainState, dict] | None:
         """Auto-resume: newest valid checkpoint (latest, falling back to best)."""

@@ -1,0 +1,63 @@
+"""MSR-VTT import CLI: standard distribution -> framework dataset files.
+
+    python -m cst_captioning_tpu.cli.import_msrvtt \\
+        --videodatainfo videodatainfo.json --out-dir data/msrvtt \\
+        --feature resnet=/path/to/resnet_feats.h5 \\
+        --feature c3d=/path/to/c3d_npy_dir
+
+Feature sources are either an h5 keyed by video id or a directory of
+``<video_id>.npy`` arrays. The output is consumable directly:
+
+    python -m cst_captioning_tpu.cli.train --preset msrvtt_xe_attention \\
+        --info-json data/msrvtt/info.json \\
+        --feature resnet=data/msrvtt/resnet.h5 --feature c3d=data/msrvtt/c3d.h5 \\
+        --set "data__cider_df='data/msrvtt/cider_df.pkl'"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from cst_captioning_tpu.data.importers import import_msrvtt
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--videodatainfo", required=True,
+                   help="MSR-VTT videodatainfo.json")
+    p.add_argument("--out-dir", required=True)
+    p.add_argument(
+        "--feature",
+        action="append",
+        default=[],
+        metavar="NAME=SOURCE",
+        help="modality source (h5 keyed by video id, or dir of <vid>.npy)",
+    )
+    p.add_argument("--min-word-count", type=int, default=2)
+    p.add_argument("--no-weights", action="store_true",
+                   help="skip consensus (WXE) weight computation")
+    p.add_argument("--no-df", action="store_true",
+                   help="skip CIDEr df computation")
+    args = p.parse_args(argv)
+
+    features = {}
+    for pair in args.feature:
+        name, sep, src = pair.partition("=")
+        if not sep:
+            raise SystemExit(f"--feature expects NAME=SOURCE, got {pair!r}")
+        features[name] = src
+
+    paths = import_msrvtt(
+        args.videodatainfo,
+        args.out_dir,
+        features=features,
+        min_word_count=args.min_word_count,
+        write_consensus_weights=not args.no_weights,
+        write_cider_df=not args.no_df,
+    )
+    print(json.dumps(paths, indent=2))
+
+
+if __name__ == "__main__":
+    main()

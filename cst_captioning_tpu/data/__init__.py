@@ -17,8 +17,10 @@ from cst_captioning_tpu.data.preprocess import (
     compute_consensus_weights,
     compute_cider_df,
 )
+from cst_captioning_tpu.data.importers import import_msrvtt
 
 __all__ = [
+    "import_msrvtt",
     "Vocab",
     "CaptionDataset",
     "VideoRecord",

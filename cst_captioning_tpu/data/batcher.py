@@ -14,6 +14,11 @@ Two iteration modes:
 
 Short final batches are wrapped (circular) with a ``valid`` row mask so shapes
 stay static while eval stays exact.
+
+Shuffling is keyed by ``(seed, epoch_index)`` — not a running RNG stream — so
+a resumed run that sets :attr:`Batcher.epoch_index` from the checkpoint epoch
+reproduces the exact batch order of an uninterrupted run (SURVEY.md §3.5
+resume semantics, hardened with determinism the reference never had).
 """
 
 from __future__ import annotations
@@ -70,10 +75,11 @@ class Batcher:
         self.max_len = max_len
         self.mode = mode
         self.seq_per_vid = seq_per_vid
-        self.rng = np.random.default_rng(seed)
+        self.seed = seed
+        self.epoch_index = 0  # set from the checkpoint epoch on resume
         self.drop_last = drop_last
 
-    def _items(self, shuffle: bool) -> list[tuple[int, int]]:
+    def _items(self, rng: np.random.Generator | None) -> list[tuple[int, int]]:
         """List of (record_idx, caption_idx) rows for one epoch."""
         items: list[tuple[int, int]] = []
         for ri, rec in enumerate(self.ds.records):
@@ -82,17 +88,23 @@ class Batcher:
                 items.append((ri, 0))
             else:
                 k = min(self.seq_per_vid, ncap)
-                caps = self.rng.choice(ncap, size=k, replace=False) if shuffle else range(k)
+                caps = rng.choice(ncap, size=k, replace=False) if rng is not None else range(k)
                 items.extend((ri, int(ci)) for ci in caps)
-        if shuffle:
-            self.rng.shuffle(items)
+        if rng is not None:
+            rng.shuffle(items)
         return items
 
     def __iter__(self):
         return self.epoch(shuffle=self.mode == "caption")
 
     def epoch(self, shuffle: bool = True):
-        items = self._items(shuffle)
+        # per-epoch derived RNG: order depends only on (seed, epoch_index);
+        # unshuffled epochs (eval, template peeks) consume no epoch index
+        rng = None
+        if shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch_index))
+            self.epoch_index += 1
+        items = self._items(rng)
         bs = self.batch_size
         n = len(items)
         for start in range(0, n, bs):
@@ -146,7 +158,7 @@ class Batcher:
         )
 
     def num_batches(self) -> int:
-        n = len(self._items(shuffle=False))
+        n = len(self._items(None))
         if self.drop_last:
             return n // self.batch_size
         return -(-n // self.batch_size)

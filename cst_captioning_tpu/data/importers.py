@@ -1,0 +1,160 @@
+"""Importers: standard public dataset layouts -> the framework's on-disk schema.
+
+The reference consumed MSR-VTT/MSVD through ad-hoc preprocessing scripts
+(SURVEY.md §2 row 3, §3.4); our schema (``info.json`` + one h5 per modality,
+:mod:`cst_captioning_tpu.data.dataset`) is self-chosen, so this module is the
+documented bridge from the standard distributions to it — the first real-data
+run should be a converter call, not a surprise (VERDICT r1 missing #8).
+
+MSR-VTT ``videodatainfo.json`` layout (the 2016 challenge distribution):
+
+    {"videos":    [{"video_id": "video0", "split": "train", ...}, ...],
+     "sentences": [{"video_id": "video0", "caption": "a man is ...", ...}, ...]}
+
+splits are named ``train`` / ``validate`` / ``test``; we map ``validate`` ->
+``val``. Features are accepted either as an existing h5 keyed by video id
+(copied/filtered) or as a directory of ``<video_id>.npy`` arrays (packed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Mapping
+
+import numpy as np
+
+from cst_captioning_tpu.data.preprocess import (
+    compute_cider_df,
+    compute_consensus_weights,
+    tokenize_captions,
+    build_vocab,
+)
+
+try:
+    import h5py
+except ImportError:  # pragma: no cover - h5py is baked into the image
+    h5py = None
+
+_SPLIT_MAP = {"train": "train", "validate": "val", "val": "val", "test": "test"}
+
+
+def parse_msrvtt_info(videodatainfo: str | Mapping) -> tuple[dict, dict]:
+    """-> (raw_captions {vid: [sentence, ...]}, splits {vid: split}).
+
+    Accepts a path to ``videodatainfo.json`` or the already-loaded dict.
+    """
+    if isinstance(videodatainfo, str):
+        with open(videodatainfo) as f:
+            videodatainfo = json.load(f)
+    splits: dict[str, str] = {}
+    for v in videodatainfo["videos"]:
+        vid = str(v["video_id"])
+        split = _SPLIT_MAP.get(str(v.get("split", "train")).lower())
+        if split is None:
+            raise ValueError(f"unknown MSR-VTT split {v['split']!r} for {vid}")
+        splits[vid] = split
+    raw: dict[str, list[str]] = {vid: [] for vid in splits}
+    for s in videodatainfo["sentences"]:
+        vid = str(s["video_id"])
+        if vid not in raw:
+            raise ValueError(f"sentence references unknown video {vid!r}")
+        raw[vid].append(str(s["caption"]))
+    empty = [vid for vid, caps in raw.items() if not caps]
+    if empty:
+        raise ValueError(f"videos without captions: {empty[:5]}...")
+    return raw, splits
+
+
+def pack_features(source: str, out_h5: str, video_ids: list[str]) -> str:
+    """Features -> one h5 keyed by video id ([n_frames, dim] float32 each).
+
+    ``source``: an h5 (rows copied for ``video_ids``) or a directory of
+    ``<video_id>.npy`` arrays.
+    """
+    if h5py is None:
+        raise RuntimeError("h5py unavailable")
+    def as_frames(vid: str, arr: np.ndarray) -> np.ndarray:
+        """-> [n_frames, dim]; 1-D rows become a single frame; reject others."""
+        if arr.ndim == 2:
+            return arr
+        if arr.ndim == 1:
+            return arr[None, :]
+        raise ValueError(
+            f"feature for {vid!r} has shape {arr.shape}; expected "
+            "[n_frames, dim] or [dim] (strip any leading batch dimension)"
+        )
+
+    os.makedirs(os.path.dirname(out_h5) or ".", exist_ok=True)
+    with h5py.File(out_h5, "w") as out:
+        if os.path.isdir(source):
+            for vid in video_ids:
+                path = os.path.join(source, f"{vid}.npy")
+                if not os.path.exists(path):
+                    raise FileNotFoundError(f"missing feature file {path}")
+                out[vid] = as_frames(vid, np.asarray(np.load(path), np.float32))
+        else:
+            with h5py.File(source, "r") as src:
+                for vid in video_ids:
+                    if vid not in src:
+                        raise KeyError(f"{source} has no key {vid!r}")
+                    out[vid] = as_frames(vid, np.asarray(src[vid], np.float32))
+    return out_h5
+
+
+def import_msrvtt(
+    videodatainfo: str | Mapping,
+    out_dir: str,
+    features: Mapping[str, str] | None = None,
+    min_word_count: int = 2,
+    write_consensus_weights: bool = True,
+    write_cider_df: bool = True,
+) -> dict[str, str]:
+    """Convert an MSR-VTT distribution into the framework's dataset files.
+
+    Writes under ``out_dir``:
+      - ``info.json``                 (vocab + splits + tokenized captions)
+      - ``<modality>.h5``             per entry in ``features``
+      - ``consensus_weights.npz``     per-caption WXE weights (train tokenizer)
+      - ``cider_df.pkl``              train-split document frequencies
+
+    Returns a path map usable directly as ``DataConfig`` inputs.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    raw, splits = parse_msrvtt_info(videodatainfo)
+    tokenized = tokenize_captions(raw)
+    vocab = build_vocab(tokenized, min_count=min_word_count)
+
+    videos = []
+    for vid, caps in tokenized.items():
+        videos.append(
+            {
+                "id": vid,
+                "split": splits[vid],
+                "captions": [" ".join(t) for t in caps],
+                "caption_ids": [vocab.encode(t) for t in caps],
+            }
+        )
+    info_path = os.path.join(out_dir, "info.json")
+    with open(info_path, "w") as f:
+        json.dump({"vocab": vocab.words, "videos": videos}, f)
+    out = {"info_json": info_path}
+
+    train_tok = {v: t for v, t in tokenized.items() if splits[v] == "train"}
+    if write_cider_df:
+        df = compute_cider_df(train_tok)
+        df_path = os.path.join(out_dir, "cider_df.pkl")
+        df.save(df_path)
+        out["cider_df"] = df_path
+    if write_consensus_weights:
+        weights = compute_consensus_weights(train_tok)
+        w_path = os.path.join(out_dir, "consensus_weights.npz")
+        np.savez(w_path, **weights)
+        out["consensus_weights"] = w_path
+
+    vids = [v["id"] for v in videos]
+    for name, source in (features or {}).items():
+        out[name] = pack_features(
+            source, os.path.join(out_dir, f"{name}.h5"), vids
+        )
+    return out

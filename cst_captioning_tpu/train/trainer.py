@@ -11,6 +11,7 @@ step. Host batch prep overlaps device compute via the prefetch thread.
 
 from __future__ import annotations
 
+import json
 import os
 
 import jax
@@ -31,6 +32,29 @@ from cst_captioning_tpu.train.state import TrainState, create_train_state
 from cst_captioning_tpu.train.steps import batch_arrays, make_parallel_xe_step, make_xe_step
 from cst_captioning_tpu.utils.logging import EventLogger, StepTimer
 from cst_captioning_tpu.utils.profiling import StepProfiler
+
+
+# run-plumbing fields expected to differ between the original run and a
+# resumed one; excluded from drift detection so the alert stays meaningful
+_VOLATILE_CONFIG_FIELDS = frozenset({
+    "train.resume", "train.ckpt_dir", "train.profile_dir",
+    "train.profile_steps", "train.debug_nans", "eval.results_json",
+})
+
+
+def _config_drift(saved: dict, current: dict, prefix: str = "") -> list[str]:
+    """Dotted paths whose values differ between two JSON-born snapshots."""
+    out: list[str] = []
+    for key in sorted(set(saved) | set(current)):
+        path = f"{prefix}{key}"
+        if path in _VOLATILE_CONFIG_FIELDS:
+            continue
+        a, b = saved.get(key), current.get(key)
+        if isinstance(a, dict) and isinstance(b, dict):
+            out.extend(_config_drift(a, b, prefix=path + "."))
+        elif a != b:
+            out.append(path)
+    return out
 
 
 class Trainer:
@@ -80,7 +104,9 @@ class Trainer:
             self.xe_step = make_xe_step(self.model, cfg.train.label_smoothing)
 
         self.ckpt = CheckpointManager(cfg.train.ckpt_dir, metric="CIDEr-D")
-        self.epoch = 0
+        self.epoch = 0        # global epoch counter (batch-order key, logging)
+        self.xe_epochs = 0    # per-phase progress: epochs-field budgets are
+        self.rl_epochs = 0    # TOTALS, so a resumed run finishes the remainder
         if cfg.train.resume:
             self._resume()
 
@@ -114,6 +140,20 @@ class Trainer:
             replicate(self.mesh, state) if self.mesh is not None else state
         )
         self.epoch = int(infos.get("epoch", 0))
+        # old checkpoints without phase counters: assume all epochs were XE
+        self.xe_epochs = int(infos.get("xe_epochs", self.epoch))
+        self.rl_epochs = int(infos.get("rl_epochs", 0))
+        # exact data-order resume: epoch-keyed shuffling continues where the
+        # uninterrupted run would have been
+        self.batcher.epoch_index = self.epoch
+        # surface config drift between the checkpoint and this run
+        saved_cfg = infos.get("config")
+        if saved_cfg:
+            # one json round-trip canonicalizes tuples to lists, matching the
+            # JSON-born saved snapshot leaf for leaf
+            drift = _config_drift(saved_cfg, json.loads(self.cfg.to_json()))
+            if drift:
+                self.log.log("resume_config_drift", fields=drift)
         self.log.log("resume", dir=src_dir, step=int(state.step), epoch=self.epoch)
 
     def load_params_from(self, ckpt_dir: str, name: str = "best"):
@@ -158,9 +198,15 @@ class Trainer:
         )
 
     def train_xe(self, epochs: int | None = None) -> float | None:
-        """Cross-entropy (XE/WXE) phase; returns last validation CIDEr-D."""
+        """Cross-entropy (XE/WXE) phase; returns last validation CIDEr-D.
+
+        ``epochs=None`` treats ``cfg.train.epochs`` as the phase TOTAL: a
+        resumed run trains only the remainder. An explicit ``epochs`` runs
+        exactly that many more.
+        """
         cfg = self.cfg
-        epochs = epochs if epochs is not None else cfg.train.epochs
+        if epochs is None:
+            epochs = max(0, cfg.train.epochs - self.xe_epochs)
         timer = StepTimer()
         profiler = StepProfiler(
             os.path.join(cfg.train.profile_dir, "xe") if cfg.train.profile_dir
@@ -190,6 +236,7 @@ class Trainer:
                     timer.tick(cfg.data.batch_size)
             profiler.stop()
             self.epoch += 1
+            self.xe_epochs += 1
             self.log.log(
                 "xe_epoch",
                 epoch=self.epoch,
@@ -200,9 +247,15 @@ class Trainer:
         return last_val
 
     def train_rl(self, epochs: int | None = None) -> float | None:
-        """CST/RL phase (SCST or consensus-CST per cfg.rl)."""
+        """CST/RL phase (SCST or consensus-CST per cfg.rl).
+
+        ``epochs=None``: ``cfg.rl.epochs`` is the phase TOTAL (see train_xe).
+        """
         cfg = self.cfg
-        epochs = epochs if epochs is not None else cfg.rl.epochs
+        if epochs is None:
+            epochs = max(0, cfg.rl.epochs - self.rl_epochs)
+        if epochs == 0:
+            return None
         # fresh optimizer at RL LR (handoff semantics)
         tx = make_optimizer(cfg.train, self.steps_per_epoch, lr_override=cfg.rl.lr)
         self.state = self.state.replace(
@@ -232,6 +285,9 @@ class Trainer:
             mode="video",
             seed=cfg.data.shuffle_seed,
         )
+        # keyed off the global epoch so a resumed RL phase replays the same
+        # per-epoch batch order as an uninterrupted run
+        rl_batcher.epoch_index = self.epoch
         rng = jax.random.key(cfg.train.seed + 1)
         timer = StepTimer()
         profiler = StepProfiler(
@@ -263,6 +319,7 @@ class Trainer:
             )
             profiler.stop()
             self.epoch += 1
+            self.rl_epochs += 1
             self.log.log(
                 "rl_epoch",
                 epoch=self.epoch,
@@ -283,7 +340,16 @@ class Trainer:
             value = result["metrics"].get("CIDEr-D")
             self.log.log("validate", epoch=self.epoch, cider_d=value)
         is_best = self.ckpt.save(
-            jax.device_get(self.state), value, infos={"epoch": self.epoch}
+            jax.device_get(self.state),
+            value,
+            # full config snapshot: the reference's `infos` pickle carried the
+            # whole opt namespace (SURVEY.md §5 checkpoint row)
+            infos={
+                "epoch": self.epoch,
+                "xe_epochs": self.xe_epochs,
+                "rl_epochs": self.rl_epochs,
+                "config": self.cfg.to_dict(),
+            },
         )
         if is_best:
             self.log.log("new_best", epoch=self.epoch, cider_d=value)

@@ -192,3 +192,93 @@ def test_cli_observability_flags_map_to_config():
     cfg = load_config(args)
     assert cfg.train.profile_dir == "/tmp/tr"
     assert cfg.train.debug_nans is True
+
+
+def test_checkpoint_infos_carry_config_snapshot(datasets, tmp_path_factory):
+    """SURVEY.md §5: the reference's infos pickle carried the full opt
+    namespace; ours carries the full ExperimentConfig dict."""
+    from cst_captioning_tpu.config.config import ExperimentConfig
+
+    train_ds, val_ds = datasets
+    ckpt_dir = str(tmp_path_factory.mktemp("ckptcfg"))
+    cfg = make_cfg(ckpt_dir, len(train_ds.vocab))
+    cfg = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(cfg.train, epochs=1, eval_every_epochs=1),
+    )
+    Trainer(cfg, train_ds, val_ds, use_mesh=False).train_xe()
+    infos = json.load(open(ckpt_dir + "/latest/infos.json"))
+    assert "config" in infos
+    # round-trips back into a typed config equal to the original
+    assert ExperimentConfig.from_dict(infos["config"]) == cfg
+    # latest/ best_value is the post-update value, not the stale one
+    best_infos = json.load(open(ckpt_dir + "/best/infos.json"))
+    assert infos["best_value"] == best_infos["best_value"]
+
+
+def test_resume_reproduces_batch_order(datasets, tmp_path_factory):
+    """Interrupt + restart with the SAME config (epochs is a total budget)
+    must equal the uninterrupted run, bit-identical params."""
+    import jax
+
+    train_ds, val_ds = datasets
+    base = make_cfg("", len(train_ds.vocab))
+
+    def run(ckpt_dir, total_epochs, resume="", run_epochs=None):
+        cfg = dataclasses.replace(
+            base,
+            train=dataclasses.replace(
+                base.train, epochs=total_epochs, ckpt_dir=ckpt_dir,
+                resume=resume, eval_every_epochs=100,
+            ),
+        )
+        tr = Trainer(cfg, train_ds, val_ds=None, use_mesh=False)
+        tr.train_xe(run_epochs)
+        return tr
+
+    d1 = str(tmp_path_factory.mktemp("straight"))
+    d2 = str(tmp_path_factory.mktemp("resumed"))
+    tr_straight = run(d1, total_epochs=2)
+    # "crash" after 1 of the 2 budgeted epochs, then rerun the same command
+    run(d2, total_epochs=2, run_epochs=1)
+    tr_resumed = run(d2, total_epochs=2, resume="auto")
+
+    assert tr_resumed.xe_epochs == tr_straight.xe_epochs == 2
+    assert int(tr_resumed.state.step) == int(tr_straight.state.step)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(tr_straight.state.params),
+        jax.tree_util.tree_leaves(tr_resumed.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # a further resume with the full budget already trained is a no-op
+    tr_done = run(d2, total_epochs=2, resume="auto")
+    assert int(tr_done.state.step) == int(tr_straight.state.step)
+
+
+def test_resume_logs_config_drift(datasets, tmp_path_factory):
+    train_ds, _ = datasets
+    ckpt_dir = str(tmp_path_factory.mktemp("ckptdrift"))
+    log1 = ckpt_dir + "/l1.jsonl"
+    log2 = ckpt_dir + "/l2.jsonl"
+    cfg = make_cfg(ckpt_dir, len(train_ds.vocab))
+    cfg = dataclasses.replace(cfg, train=dataclasses.replace(cfg.train, epochs=1))
+    Trainer(cfg, train_ds, None, log_path=log1, use_mesh=False).train_xe()
+
+    # identical config (only the volatile resume field differs): NO drift event
+    cfg_same = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, resume="auto")
+    )
+    log_same = ckpt_dir + "/lsame.jsonl"
+    Trainer(cfg_same, train_ds, None, log_path=log_same, use_mesh=False)
+    events = [json.loads(l) for l in open(log_same)]
+    assert not [e for e in events if e["event"] == "resume_config_drift"]
+
+    # a real hyperparameter change IS flagged, by its dotted path
+    cfg2 = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(cfg.train, resume="auto", lr=9e-9),
+    )
+    Trainer(cfg2, train_ds, None, log_path=log2, use_mesh=False)
+    events = [json.loads(l) for l in open(log2)]
+    drift = [e for e in events if e["event"] == "resume_config_drift"]
+    assert drift and drift[0]["fields"] == ["train.lr"]
