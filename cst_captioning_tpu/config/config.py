@@ -49,6 +49,13 @@ class ModelConfig:
     max_frames: int = 60        # frame-axis padding length
     dtype: str = "bfloat16"     # compute dtype for MXU-friendly matmuls
     param_dtype: str = "float32"
+    # sequence/context parallelism (SURVEY.md §5 long-context row): when set
+    # to a mesh axis name, the model must run inside shard_map with the FRAME
+    # axis of feats/masks sharded over that axis; the only frame-crossing
+    # reductions (attention softmax, carry-init pooling) become collective
+    # (pmax/psum over ICI), so videos longer than one chip's HBM still train
+    # and decode. "" = single-device frame axis (the default).
+    seq_axis: str = ""
 
     def __post_init__(self):
         if isinstance(self.modalities, Mapping):

@@ -5,11 +5,19 @@ state with ``v^T tanh(W_f f + W_h h)`` (CST paper §3.1 / SURVEY.md §5). Here
 the memory projection ``W_f f`` is precomputed once per sequence by the
 encoder (it does not depend on the step), so the per-step cost is one small
 matmul + a masked softmax — XLA fuses the whole step into a couple of kernels.
+
+Sequence parallelism (``seq_axis`` set): the memory bank arrives FRAME-SHARDED
+across the mesh axis and the softmax becomes a two-pass distributed reduction
+— ``pmax`` of the local score maxima, then one ``psum`` of the (numerator,
+denominator) pair, the "one-step ring" of SURVEY.md §5's long-context row.
+Attention is permutation-invariant over memory slots, so sharded results
+equal the single-device softmax exactly (up to f32 summation order).
 """
 
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -17,6 +25,8 @@ class AdditiveAttention(nn.Module):
     d_att: int
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    # mesh axis the frame dimension is sharded over ("" = not sharded)
+    seq_axis: str = ""
 
     def setup(self):
         self.mem_proj = nn.Dense(
@@ -49,6 +59,27 @@ class AdditiveAttention(nn.Module):
         # -1e9, not -inf: a row with zero valid slots must yield a finite
         # (uniform) softmax over zeroed memory, not NaNs that poison the step
         scores = jnp.where(memory_mask > 0, scores, -1.0e9)
+        if self.seq_axis:
+            return self._sharded_softmax_attend(scores, memory)
         # softmax in f32 for stability regardless of compute dtype
         weights = nn.softmax(scores.astype(jnp.float32), axis=-1).astype(memory.dtype)
         return jnp.einsum("bm,bme->be", weights, memory)
+
+    def _sharded_softmax_attend(
+        self, scores: jnp.ndarray, memory: jnp.ndarray
+    ) -> jnp.ndarray:
+        """Distributed masked softmax over the frame-sharded memory axis."""
+        s = scores.astype(jnp.float32)                         # [B, M_local]
+        # global max is a constant shift for softmax — stop_gradient both
+        # keeps the math exact and sidesteps pmax's missing diff rule
+        m = jax.lax.pmax(
+            jax.lax.stop_gradient(jnp.max(s, axis=-1)), self.seq_axis
+        )                                                      # [B] global max
+        w = jnp.exp(s - m[:, None])
+        den = jax.lax.psum(jnp.sum(w, axis=-1), self.seq_axis)              # [B]
+        num = jax.lax.psum(
+            jnp.einsum("bm,bme->be", w.astype(memory.dtype), memory)
+            .astype(jnp.float32),
+            self.seq_axis,
+        )                                                      # [B, E]
+        return (num / den[:, None]).astype(memory.dtype)

@@ -79,7 +79,7 @@ class CaptionModel(nn.Module):
     ) -> EncoderOutput:
         memory, mmask = self.encoder(feats, masks)
         memory_proj = self.cell.project_memory(memory)
-        ctx0 = masked_mean(memory, mmask, axis=1)
+        ctx0 = masked_mean(memory, mmask, axis=1, axis_name=self.cfg.seq_axis)
         carry = tuple(
             (jnp.tanh(self.init_c[i](ctx0)), jnp.tanh(self.init_h[i](ctx0)))
             for i in range(self.cfg.num_layers)

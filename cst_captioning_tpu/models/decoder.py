@@ -32,7 +32,8 @@ class DecoderCell(nn.Module):
             dtype=dtype, param_dtype=pdtype,
         )
         self.attention = AdditiveAttention(
-            d_att=cfg.d_att, dtype=dtype, param_dtype=pdtype, name="attention"
+            d_att=cfg.d_att, dtype=dtype, param_dtype=pdtype, name="attention",
+            seq_axis=cfg.seq_axis,
         )
         self.lstm = [
             nn.OptimizedLSTMCell(

@@ -14,17 +14,30 @@ Capability map to the reference (SURVEY.md §2 row 4):
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from cst_captioning_tpu.config.config import ModelConfig
 
 
-def masked_mean(x: jnp.ndarray, mask: jnp.ndarray, axis: int) -> jnp.ndarray:
-    """Mean over ``axis`` counting only mask==1 positions."""
+def masked_mean(
+    x: jnp.ndarray, mask: jnp.ndarray, axis: int, axis_name: str = ""
+) -> jnp.ndarray:
+    """Mean over ``axis`` counting only mask==1 positions.
+
+    ``axis_name``: mesh axis ``axis`` is additionally sharded over (sequence
+    parallelism) — numerator and count are psum'd before the divide, so the
+    result equals the unsharded mean. Also correct when the input is merely
+    REPLICATED over that axis: both sums scale by the device count and the
+    ratio cancels.
+    """
     mask = mask.astype(x.dtype)
     num = jnp.sum(x * jnp.expand_dims(mask, -1), axis=axis)
-    den = jnp.maximum(jnp.sum(mask, axis=axis), 1.0)[..., None]
-    return num / den
+    den = jnp.sum(mask, axis=axis)
+    if axis_name:
+        num = jax.lax.psum(num, axis_name)
+        den = jax.lax.psum(den, axis_name)
+    return num / jnp.maximum(den, 1.0)[..., None]
 
 
 class MeanPoolEncoder(nn.Module):
@@ -39,7 +52,10 @@ class MeanPoolEncoder(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         slots = []
         for name, _ in cfg.modalities:
-            pooled = masked_mean(feats[name].astype(dtype), masks[name], axis=1)
+            pooled = masked_mean(
+                feats[name].astype(dtype), masks[name], axis=1,
+                axis_name=cfg.seq_axis,
+            )
             emb = nn.Dense(
                 cfg.d_embed, name=f"embed_{name}",
                 dtype=dtype, param_dtype=jnp.dtype(cfg.param_dtype),

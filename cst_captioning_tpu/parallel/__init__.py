@@ -1,0 +1,24 @@
+"""Sequence/context parallelism (SURVEY.md §5 long-context row).
+
+The reference never needed SP — captions are ~30 tokens and clips ~60 frames.
+This package makes the frame axis shardable anyway, so videos 100x longer
+than one chip's HBM budget still encode, train, and decode: the memory bank
+lives frame-sharded across the mesh and the only frame-crossing reductions
+(attention softmax, pooled carry init) run as XLA collectives over ICI.
+"""
+
+from cst_captioning_tpu.parallel.seq_parallel import (
+    make_sp_decode,
+    make_sp_forward,
+    make_sp_xe_step,
+    sp_batch_specs,
+    sp_model,
+)
+
+__all__ = [
+    "make_sp_decode",
+    "make_sp_forward",
+    "make_sp_xe_step",
+    "sp_batch_specs",
+    "sp_model",
+]

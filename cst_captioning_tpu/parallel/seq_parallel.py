@@ -1,0 +1,162 @@
+"""Frame-axis sequence parallelism: shard the video, psum the attention.
+
+Design (SURVEY.md §5 long-context row, "one-step ring"): every op in the
+caption model is frame-local EXCEPT the attention softmax and the carry-init
+pooling. With ``ModelConfig.seq_axis`` set, those two become collective
+(``pmax`` + ``psum`` over the mesh axis — see ``models/attention.py``), so the
+model body runs unchanged inside ``shard_map`` with ``feats``/``masks``
+sharded on their frame axis. Everything downstream of the psums is
+device-invariant, which means:
+
+- decode (greedy / K-rollout sampling / beam) works as-is — every device
+  steps the same replicated LSTM against its own frame shard;
+- training gradients are taken OUTSIDE the shard_map: JAX's varying-axis
+  machinery (check_vma) transposes the collectives correctly, producing
+  global grads — frame-sharded params (encoder embeds, attention memory
+  projection) get their partial contributions summed, replicated-path params
+  (LSTM, output projection) stay exact. Pinned against single-device grads
+  in tests/test_seq_parallel.py.
+
+Composition with data parallelism: a 2-D ``Mesh(('data', 'seq'))`` shards the
+batch over 'data' and frames over 'seq'; the XE step psums the loss over
+'data' exactly like train/steps.py does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cst_captioning_tpu.config.config import ModelConfig
+from cst_captioning_tpu.decoding import greedy_decode, sample_decode
+from cst_captioning_tpu.losses import masked_cross_entropy
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.train.state import TrainState
+
+
+def sp_model(cfg: ModelConfig, seq_axis: str = "seq") -> CaptionModel:
+    """A CaptionModel whose frame-axis reductions are collective over ``seq_axis``.
+
+    Parameters are layout-identical to the unsharded model — checkpoints
+    trained one way load the other way.
+    """
+    return CaptionModel(dataclasses.replace(cfg, seq_axis=seq_axis))
+
+
+def sp_batch_specs(cfg: ModelConfig, data_axis: str = "",
+                   seq_axis: str = "seq"):
+    """(feats_specs, masks_specs): frame axis on ``seq_axis``, batch axis on
+    ``data_axis`` (or replicated when empty)."""
+    b = data_axis if data_axis else None
+    feats = {name: P(b, seq_axis) for name, _ in cfg.modalities}
+    masks = {name: P(b, seq_axis) for name, _ in cfg.modalities}
+    return feats, masks
+
+
+def make_sp_forward(model: CaptionModel, mesh: Mesh, data_axis: str = "",
+                    seq_axis: str = "seq") -> Callable:
+    """Jitted teacher-forced forward: (params, feats, masks, labels) -> logits.
+
+    Logits replicate over 'seq' (they sit downstream of the attention psum)
+    and shard over ``data_axis`` when given.
+    """
+    f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
+    b = data_axis if data_axis else None
+
+    def fwd(params, feats, masks, labels):
+        return model.apply(params, feats, masks, labels)
+
+    sharded = jax.shard_map(
+        fwd,
+        mesh=mesh,
+        in_specs=(P(), f_spec, m_spec, P(b)),
+        out_specs=P(b),
+    )
+    return jax.jit(sharded)
+
+
+def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
+                   temperature: float = 1.0, max_len: int | None = None,
+                   seq_axis: str = "seq") -> Callable:
+    """Jitted SP decode: (params, feats, masks, rng) -> (greedy, samples|None).
+
+    The long-video RL/eval decode: frames sharded, batch replicated. With
+    ``num_rollouts=0`` only the greedy decode runs (eval path).
+    """
+    f_spec, m_spec = sp_batch_specs(model.cfg, "", seq_axis)
+
+    def dec(params, feats, masks, rng):
+        greedy, _ = greedy_decode(model, params, feats, masks, max_len=max_len)
+        if num_rollouts:
+            samples, _ = sample_decode(
+                model, params, feats, masks, rng,
+                num_rollouts=num_rollouts, temperature=temperature,
+                max_len=max_len,
+            )
+        else:
+            samples = greedy  # stable output structure for jit
+        return greedy, samples
+
+    sharded = jax.shard_map(
+        dec,
+        mesh=mesh,
+        in_specs=(P(), f_spec, m_spec, P()),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded)
+
+
+def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
+                    label_smoothing: float = 0.0, data_axis: str = "",
+                    seq_axis: str = "seq") -> Callable:
+    """Jitted SP (optionally DP x SP) XE train step.
+
+    The loss is computed inside shard_map (loss psum'd over ``data_axis``
+    when sharded); ``value_and_grad`` wraps the WHOLE sharded computation, so
+    the collective transposes produce exact global gradients.
+    """
+    f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
+    b = data_axis if data_axis else None
+
+    def sharded_loss(params, feats, masks, labels, mask, weights, drng):
+        if data_axis:
+            drng = jax.random.fold_in(drng, jax.lax.axis_index(data_axis))
+        logits = model.apply(
+            params, feats, masks, labels, train=True, rngs={"dropout": drng}
+        )
+        w_mask = mask * weights[:, None]
+        den = jnp.sum(w_mask)
+        num = masked_cross_entropy(
+            logits, labels, mask, weights=weights,
+            label_smoothing=label_smoothing,
+        ) * den
+        if data_axis:
+            num = jax.lax.psum(num, data_axis)
+            den = jax.lax.psum(den, data_axis)
+        return num / jnp.maximum(den, 1.0)
+
+    sm = jax.shard_map(
+        sharded_loss,
+        mesh=mesh,
+        in_specs=(P(), f_spec, m_spec, P(b), P(b), P(b), P()),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def step(state: TrainState, feats, masks, labels, mask, weights):
+        drng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(p):
+            return sm(p, feats, masks, labels, mask, weights, drng)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        gnorm = optax.global_norm(grads)
+        state = state.apply_gradients(grads)
+        return state, {"loss": loss, "grad_norm": gnorm}
+
+    return step
