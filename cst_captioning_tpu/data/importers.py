@@ -12,14 +12,32 @@ MSR-VTT ``videodatainfo.json`` layout (the 2016 challenge distribution):
      "sentences": [{"video_id": "video0", "caption": "a man is ...", ...}, ...]}
 
 splits are named ``train`` / ``validate`` / ``test``; we map ``validate`` ->
-``val``. Features are accepted either as an existing h5 keyed by video id
+``val``.
+
+MSVD (Microsoft Video Description corpus / YouTubeClips) has no split field at
+all; its standard distribution is
+
+  - a caption CSV (``video_corpus.csv`` / "MSR Video Description Corpus"):
+    columns ``VideoID, Start, End, ..., Language, Description``, one row per
+    (clip, annotation); the clip id is ``{VideoID}_{Start}_{End}`` and only
+    ``Language == English`` rows are captions. A plain-text variant
+    (``<clip_id> <caption>`` per line, e.g. AllVideoDescriptions.txt) is also
+    accepted.
+  - optionally ``youtube_mapping.txt`` (``<clip_id> vid<N>`` per line) fixing
+    the canonical clip order; the conventional captioning split is then the
+    first 1200 clips train / next 100 val / remaining 670 test (the boundaries
+    used by the CST paper's MSVD experiments — BASELINE config 1).
+
+Features are accepted either as an existing h5 keyed by video id
 (copied/filtered) or as a directory of ``<video_id>.npy`` arrays (packed).
 """
 
 from __future__ import annotations
 
+import csv
 import json
 import os
+import re
 from typing import Mapping
 
 import numpy as np
@@ -37,6 +55,10 @@ except ImportError:  # pragma: no cover - h5py is baked into the image
     h5py = None
 
 _SPLIT_MAP = {"train": "train", "validate": "val", "val": "val", "test": "test"}
+
+# conventional MSVD captioning split boundaries (1200/100/670 of 1970 clips)
+MSVD_NUM_TRAIN = 1200
+MSVD_NUM_VAL = 100
 
 
 def parse_msrvtt_info(videodatainfo: str | Mapping) -> tuple[dict, dict]:
@@ -63,6 +85,123 @@ def parse_msrvtt_info(videodatainfo: str | Mapping) -> tuple[dict, dict]:
     empty = [vid for vid, caps in raw.items() if not caps]
     if empty:
         raise ValueError(f"videos without captions: {empty[:5]}...")
+    return raw, splits
+
+
+def _parse_msvd_csv(path: str) -> dict[str, list[str]]:
+    """MSR Video Description Corpus csv -> {clip_id: [sentence, ...]}.
+
+    Column names are matched case-insensitively; non-English rows and rows
+    with an empty description are skipped.
+    """
+    raw: dict[str, list[str]] = {}
+    with open(path, newline="", encoding="utf-8", errors="replace") as f:
+        reader = csv.DictReader(f)
+        if reader.fieldnames is None:
+            raise ValueError(f"{path}: empty csv")
+        cols = {c.strip().lower(): c for c in reader.fieldnames}
+        missing = [c for c in ("videoid", "start", "end", "description")
+                   if c not in cols]
+        if missing:
+            raise ValueError(
+                f"{path}: not an MSVD corpus csv (missing columns {missing}; "
+                f"found {reader.fieldnames})"
+            )
+        lang_col = cols.get("language")
+        for row in reader:
+            if lang_col and row[lang_col].strip().lower() != "english":
+                continue
+            sent = (row[cols["description"]] or "").strip()
+            if not sent:
+                continue
+            clip = (
+                f"{row[cols['videoid']].strip()}_"
+                f"{row[cols['start']].strip()}_{row[cols['end']].strip()}"
+            )
+            raw.setdefault(clip, []).append(sent)
+    return raw
+
+
+def _parse_msvd_txt(path: str) -> dict[str, list[str]]:
+    """``<clip_id> <caption>`` per line -> {clip_id: [sentence, ...]}."""
+    raw: dict[str, list[str]] = {}
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            clip, _, sent = line.partition(" ")
+            if sent.strip():
+                raw.setdefault(clip, []).append(sent.strip())
+    return raw
+
+
+def parse_msvd_mapping(path: str) -> list[str]:
+    """``youtube_mapping.txt`` (``<clip_id> vid<N>`` per line) -> clip ids in
+    canonical order (sorted by N)."""
+    indexed: list[tuple[int, str]] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            clip, _, tag = line.rpartition(" ")
+            m = re.fullmatch(r"vid(\d+)", tag.strip())
+            if not clip or m is None:
+                raise ValueError(
+                    f"{path}: expected '<clip_id> vid<N>' lines, got {line!r}"
+                )
+            indexed.append((int(m.group(1)), clip.strip()))
+    indexed.sort()
+    return [clip for _, clip in indexed]
+
+
+def parse_msvd_corpus(
+    corpus: str | Mapping,
+    mapping: str | None = None,
+    n_train: int = MSVD_NUM_TRAIN,
+    n_val: int = MSVD_NUM_VAL,
+) -> tuple[dict, dict]:
+    """-> (raw_captions {clip_id: [sentence, ...]}, splits {clip_id: split}).
+
+    ``corpus`` is the caption file (csv or ``<clip_id> <caption>`` text) or an
+    already-loaded ``{clip_id: [sentence, ...]}`` mapping. ``mapping`` is the
+    optional ``youtube_mapping.txt`` fixing the canonical clip order (clips
+    absent from it are dropped, mirroring the conventional 1970-clip subset);
+    without it clips are ordered by sorted id. The first ``n_train`` clips are
+    the train split, the next ``n_val`` the val split, the remainder test.
+    """
+    if isinstance(corpus, Mapping):
+        raw = {str(k): [str(s) for s in v] for k, v in corpus.items()}
+    elif corpus.endswith(".csv"):
+        raw = _parse_msvd_csv(corpus)
+    else:
+        raw = _parse_msvd_txt(corpus)
+    if not raw:
+        raise ValueError("MSVD corpus contains no captions")
+
+    if mapping is not None:
+        order = parse_msvd_mapping(mapping)
+        missing = [c for c in order if c not in raw or not raw[c]]
+        if missing:
+            raise ValueError(
+                f"mapped clips without captions: {missing[:5]}..."
+            )
+        raw = {clip: raw[clip] for clip in order}
+    else:
+        order = sorted(raw)
+        raw = {clip: raw[clip] for clip in order}
+
+    if len(order) <= n_train:
+        raise ValueError(
+            f"only {len(order)} clips for n_train={n_train}, n_val={n_val}; "
+            "pass split sizes matching the corpus"
+        )
+    splits = {
+        clip: ("train" if i < n_train else "val" if i < n_train + n_val
+               else "test")
+        for i, clip in enumerate(order)
+    }
     return raw, splits
 
 
@@ -102,6 +241,62 @@ def pack_features(source: str, out_h5: str, video_ids: list[str]) -> str:
     return out_h5
 
 
+def _write_dataset(
+    out_dir: str,
+    raw: Mapping[str, list[str]],
+    splits: Mapping[str, str],
+    features: Mapping[str, str] | None,
+    min_word_count: int,
+    write_consensus_weights: bool,
+    write_cider_df: bool,
+) -> dict[str, str]:
+    """Tokenized corpus + splits -> info.json / h5 / weights / df on disk.
+
+    Shared tail of every importer. The vocab is built from the TRAIN split
+    only (standard preprocessing: val/test-only words encode to <unk>), the
+    same restriction already applied to the CIDEr df and consensus weights.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    tokenized = tokenize_captions(raw)
+    train_tok = {v: t for v, t in tokenized.items() if splits[v] == "train"}
+    if not train_tok:
+        raise ValueError("no train-split videos — cannot build a vocab")
+    vocab = build_vocab(train_tok, min_count=min_word_count)
+
+    videos = []
+    for vid, caps in tokenized.items():
+        videos.append(
+            {
+                "id": vid,
+                "split": splits[vid],
+                "captions": [" ".join(t) for t in caps],
+                "caption_ids": [vocab.encode(t) for t in caps],
+            }
+        )
+    info_path = os.path.join(out_dir, "info.json")
+    with open(info_path, "w") as f:
+        json.dump({"vocab": vocab.words, "videos": videos}, f)
+    out = {"info_json": info_path}
+
+    if write_cider_df:
+        df = compute_cider_df(train_tok)
+        df_path = os.path.join(out_dir, "cider_df.pkl")
+        df.save(df_path)
+        out["cider_df"] = df_path
+    if write_consensus_weights:
+        weights = compute_consensus_weights(train_tok)
+        w_path = os.path.join(out_dir, "consensus_weights.npz")
+        np.savez(w_path, **weights)
+        out["consensus_weights"] = w_path
+
+    vids = [v["id"] for v in videos]
+    for name, source in (features or {}).items():
+        out[name] = pack_features(
+            source, os.path.join(out_dir, f"{name}.h5"), vids
+        )
+    return out
+
+
 def import_msrvtt(
     videodatainfo: str | Mapping,
     out_dir: str,
@@ -120,41 +315,35 @@ def import_msrvtt(
 
     Returns a path map usable directly as ``DataConfig`` inputs.
     """
-    os.makedirs(out_dir, exist_ok=True)
     raw, splits = parse_msrvtt_info(videodatainfo)
-    tokenized = tokenize_captions(raw)
-    vocab = build_vocab(tokenized, min_count=min_word_count)
+    return _write_dataset(
+        out_dir, raw, splits, features, min_word_count,
+        write_consensus_weights, write_cider_df,
+    )
 
-    videos = []
-    for vid, caps in tokenized.items():
-        videos.append(
-            {
-                "id": vid,
-                "split": splits[vid],
-                "captions": [" ".join(t) for t in caps],
-                "caption_ids": [vocab.encode(t) for t in caps],
-            }
-        )
-    info_path = os.path.join(out_dir, "info.json")
-    with open(info_path, "w") as f:
-        json.dump({"vocab": vocab.words, "videos": videos}, f)
-    out = {"info_json": info_path}
 
-    train_tok = {v: t for v, t in tokenized.items() if splits[v] == "train"}
-    if write_cider_df:
-        df = compute_cider_df(train_tok)
-        df_path = os.path.join(out_dir, "cider_df.pkl")
-        df.save(df_path)
-        out["cider_df"] = df_path
-    if write_consensus_weights:
-        weights = compute_consensus_weights(train_tok)
-        w_path = os.path.join(out_dir, "consensus_weights.npz")
-        np.savez(w_path, **weights)
-        out["consensus_weights"] = w_path
+def import_msvd(
+    corpus: str | Mapping,
+    out_dir: str,
+    mapping: str | None = None,
+    features: Mapping[str, str] | None = None,
+    n_train: int = MSVD_NUM_TRAIN,
+    n_val: int = MSVD_NUM_VAL,
+    min_word_count: int = 2,
+    write_consensus_weights: bool = True,
+    write_cider_df: bool = True,
+) -> dict[str, str]:
+    """Convert an MSVD distribution into the framework's dataset files.
 
-    vids = [v["id"] for v in videos]
-    for name, source in (features or {}).items():
-        out[name] = pack_features(
-            source, os.path.join(out_dir, f"{name}.h5"), vids
-        )
-    return out
+    Same outputs as :func:`import_msrvtt` (BASELINE config 1's ingestion
+    path). ``corpus``/``mapping``/``n_train``/``n_val`` are documented at
+    :func:`parse_msvd_corpus`; the defaults are the conventional
+    1200/100/670 captioning split.
+    """
+    raw, splits = parse_msvd_corpus(
+        corpus, mapping=mapping, n_train=n_train, n_val=n_val
+    )
+    return _write_dataset(
+        out_dir, raw, splits, features, min_word_count,
+        write_consensus_weights, write_cider_df,
+    )
