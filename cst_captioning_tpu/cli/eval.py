@@ -42,10 +42,11 @@ def main(argv: list[str] | None = None) -> None:
     template = model.init(jax.random.key(0), feats, masks, labels)
     params = load_params(args.ckpt_dir, args.ckpt_name, template)
 
-    # shard the decode over all visible devices (batch must divide evenly)
+    # shard the decode over all visible devices; the Evaluator wrap-pads any
+    # indivisible batch size up to a device multiple, so no silent fallback
     n_dev = cfg.mesh.num_devices or len(jax.devices())
     mesh = None
-    if n_dev > 1 and cfg.data.batch_size % n_dev == 0:
+    if n_dev > 1:
         mesh = make_mesh(cfg.mesh.num_devices)
         params = replicate(mesh, params)
 

@@ -17,10 +17,11 @@ from cst_captioning_tpu.data.preprocess import (
     compute_consensus_weights,
     compute_cider_df,
 )
-from cst_captioning_tpu.data.importers import import_msrvtt
+from cst_captioning_tpu.data.importers import import_msrvtt, import_msvd
 
 __all__ = [
     "import_msrvtt",
+    "import_msvd",
     "Vocab",
     "CaptionDataset",
     "VideoRecord",

@@ -79,6 +79,15 @@ class Trainer:
         n_dev = cfg.mesh.num_devices or len(jax.devices())
         self.use_mesh = (n_dev > 1) if use_mesh is None else use_mesh
         self.mesh = make_mesh(cfg.mesh.num_devices) if self.use_mesh else None
+        if self.mesh is not None and cfg.data.batch_size % self.mesh.devices.size:
+            # unlike eval (which wrap-pads exactly, evaluator.py), padding a
+            # TRAINING batch would change how rows group into optimizer steps
+            # — fail early with guidance instead of a device_put shape error
+            raise ValueError(
+                f"training batch_size {cfg.data.batch_size} must be divisible "
+                f"by the {self.mesh.devices.size}-device mesh; pick a multiple "
+                "or set mesh.num_devices"
+            )
 
         self.batcher = Batcher(
             train_ds,
@@ -144,8 +153,10 @@ class Trainer:
         self.xe_epochs = int(infos.get("xe_epochs", self.epoch))
         self.rl_epochs = int(infos.get("rl_epochs", 0))
         # exact data-order resume: epoch-keyed shuffling continues where the
-        # uninterrupted run would have been
-        self.batcher.epoch_index = self.epoch
+        # uninterrupted run would have been. The caption batcher consumes one
+        # epoch index per *shuffled* (XE) epoch only — RL epochs run their own
+        # video-mode batcher — so the XE count, not the global one, is the key
+        self.batcher.epoch_index = self.xe_epochs
         # surface config drift between the checkpoint and this run
         saved_cfg = infos.get("config")
         if saved_cfg:
@@ -256,15 +267,22 @@ class Trainer:
             epochs = max(0, cfg.rl.epochs - self.rl_epochs)
         if epochs == 0:
             return None
-        # fresh optimizer at RL LR (handoff semantics)
         tx = make_optimizer(cfg.train, self.steps_per_epoch, lr_override=cfg.rl.lr)
-        self.state = self.state.replace(
-            step=jax.numpy.zeros((), jax.numpy.int32), opt_state=tx.init(
-                jax.device_get(self.state.params)
-            ), tx=tx,
-        )
-        if self.mesh is not None:
-            self.state = replicate(self.mesh, self.state)
+        if self.rl_epochs == 0:
+            # XE -> RL transition: fresh optimizer at RL LR (handoff semantics)
+            self.state = self.state.replace(
+                step=jax.numpy.zeros((), jax.numpy.int32), opt_state=tx.init(
+                    jax.device_get(self.state.params)
+                ), tx=tx,
+            )
+            if self.mesh is not None:
+                self.state = replicate(self.mesh, self.state)
+        else:
+            # resumed mid-RL: the restored opt_state/step already belong to the
+            # RL optimizer (saved during RL) — keep the Adam moments and
+            # schedule position, just re-attach the non-serialized tx. The
+            # structures match: make_optimizer differs only in LR value.
+            self.state = self.state.replace(tx=tx)
 
         # df=None lets RewardComputer build the train-pool df itself
         df = CorpusDF.load(cfg.data.cider_df) if cfg.data.cider_df else None
@@ -288,7 +306,10 @@ class Trainer:
         # keyed off the global epoch so a resumed RL phase replays the same
         # per-epoch batch order as an uninterrupted run
         rl_batcher.epoch_index = self.epoch
-        rng = jax.random.key(cfg.train.seed + 1)
+        # per-epoch sampling rng is FOLDED from the global epoch, not drawn
+        # from a running split chain, so a resumed phase continues the stream
+        # (epoch k uses fold_in(base, k) whether or not the process restarted)
+        base_rng = jax.random.key(cfg.train.seed + 1)
         timer = StepTimer()
         profiler = StepProfiler(
             os.path.join(cfg.train.profile_dir, "rl") if cfg.train.profile_dir
@@ -310,7 +331,7 @@ class Trainer:
 
             # pipelined epoch: host reward for batch i overlaps device decode
             # of batch i+1; batches are prefetched to device by a host thread
-            rng, ep_rng = jax.random.split(rng)
+            ep_rng = jax.random.fold_in(base_rng, self.epoch)
             self.state, _ = scst.train_epoch(
                 self.state,
                 self._rl_device_batches(rl_batcher),

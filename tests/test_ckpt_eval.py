@@ -136,10 +136,16 @@ def test_evaluator_mesh_matches_single_device(eval_setup, beam):
     assert sharded == single
 
 
-def test_evaluator_mesh_rejects_indivisible_batch(eval_setup):
-    from cst_captioning_tpu.train import make_mesh
+def test_evaluator_mesh_pads_indivisible_batch(eval_setup):
+    """batch_size=5 on 8 devices wrap-pads to 8 and still produces the EXACT
+    single-device captions (VERDICT r2 next #5: no error, no silent
+    single-chip fallback)."""
+    from cst_captioning_tpu.train import make_mesh, replicate
 
     model, params, ds = eval_setup
-    with pytest.raises(ValueError, match="not divisible"):
-        Evaluator(model, ds, EvalConfig(beam_size=1, max_len=8),
-                  batch_size=5, mesh=make_mesh())
+    cfg = EvalConfig(beam_size=1, max_len=8)
+    single = Evaluator(model, ds, cfg, batch_size=5).generate(params)
+    mesh = make_mesh()
+    ev = Evaluator(model, ds, cfg, batch_size=5, mesh=mesh)
+    assert ev.batcher.batch_size == 8  # rounded up to the device multiple
+    assert ev.generate(replicate(mesh, params)) == single

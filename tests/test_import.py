@@ -1,5 +1,6 @@
-"""MSR-VTT importer round trip: standard distribution shape -> our schema ->
-CaptionDataset -> batches (VERDICT r1 missing #8 / SURVEY.md §3.4)."""
+"""Importer round trips: standard MSR-VTT / MSVD distribution shapes -> our
+schema -> CaptionDataset -> batches (VERDICT r1 missing #8, VERDICT r2 missing
+#1 / SURVEY.md §3.4; MSVD is BASELINE config 1's ingestion path)."""
 
 import json
 import os
@@ -8,7 +9,12 @@ import h5py
 import numpy as np
 import pytest
 
-from cst_captioning_tpu.data import Batcher, CaptionDataset, import_msrvtt
+from cst_captioning_tpu.data import (
+    Batcher,
+    CaptionDataset,
+    import_msrvtt,
+    import_msvd,
+)
 from cst_captioning_tpu.metrics.cider import CorpusDF
 
 
@@ -144,6 +150,210 @@ def test_cli_entry(msrvtt_fixture, tmp_path, capsys):
     assert os.path.exists(paths["info_json"])
     assert os.path.exists(paths["resnet"])
     assert "consensus_weights" not in paths
+
+
+# ---- MSVD (BASELINE config 1) ----------------------------------------------
+
+
+MSVD_PHRASES = [
+    "a cat chases a ball",
+    "the kitten plays with a toy",
+    "a man rides a bicycle downhill",
+    "someone is riding a bike",
+    "a chef stirs a pot of soup",
+]
+
+
+@pytest.fixture(scope="module")
+def msvd_fixture(tmp_path_factory):
+    """A tiny MSVD-shaped distribution: corpus csv + youtube mapping +
+    features, including non-English rows and an unmapped clip that the
+    conventional 1970-clip subset drops."""
+    root = tmp_path_factory.mktemp("msvd_raw")
+    rng = np.random.default_rng(1)
+    n = 8
+    clips = [f"yt{i:02d}_{i * 10}_{i * 10 + 5}" for i in range(n)]
+
+    csv_path = str(root / "video_corpus.csv")
+    with open(csv_path, "w") as f:
+        f.write("VideoID,Start,End,WorkerID,Source,AnnotationTime,"
+                "Language,Description\n")
+        for i, clip in enumerate(clips):
+            vid, start, end = f"yt{i:02d}", i * 10, i * 10 + 5
+            for j in range(3):
+                cap = MSVD_PHRASES[(i + j) % len(MSVD_PHRASES)]
+                if i >= 5 and j == 2:
+                    # val/test-only word: must NOT reach the vocab
+                    cap = f"a rare zzquux{i} appears"
+                f.write(f"{vid},{start},{end},w{j},x,1,English,{cap}\n")
+            # non-English and empty rows must be skipped
+            f.write(f"{vid},{start},{end},w9,x,1,German,eine katze\n")
+            f.write(f"{vid},{start},{end},w8,x,1,English,\n")
+        # a clip absent from the mapping: dropped by the canonical subset
+        f.write("ytXX,0,5,w0,x,1,English,this clip is not in the mapping\n")
+
+    map_path = str(root / "youtube_mapping.txt")
+    with open(map_path, "w") as f:
+        # deliberately out of file order; vid index fixes the canonical order
+        for i in reversed(range(n)):
+            f.write(f"{clips[i]} vid{i + 1}\n")
+
+    npy_dir = root / "resnet_npy"
+    npy_dir.mkdir()
+    for clip in clips:
+        np.save(str(npy_dir / f"{clip}.npy"),
+                rng.normal(size=(5, 16)).astype(np.float32))
+    return {"csv": csv_path, "mapping": map_path, "npy_dir": str(npy_dir),
+            "clips": clips, "n": n}
+
+
+@pytest.fixture(scope="module")
+def msvd_imported(msvd_fixture, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("msvd_imported"))
+    return import_msvd(
+        msvd_fixture["csv"],
+        out,
+        mapping=msvd_fixture["mapping"],
+        features={"resnet": msvd_fixture["npy_dir"]},
+        n_train=5,
+        n_val=1,
+        min_word_count=1,
+    ), msvd_fixture
+
+
+def test_msvd_import_produces_all_files(msvd_imported):
+    paths, _ = msvd_imported
+    for key in ("info_json", "resnet", "consensus_weights", "cider_df"):
+        assert key in paths and os.path.exists(paths[key]), key
+
+
+def test_msvd_split_and_order_follow_mapping(msvd_imported):
+    paths, fx = msvd_imported
+    info = json.load(open(paths["info_json"]))
+    # canonical order = mapping's vid<N> order; unmapped ytXX dropped
+    ids = [v["id"] for v in info["videos"]]
+    assert ids == fx["clips"]
+    splits = [v["split"] for v in info["videos"]]
+    assert splits == ["train"] * 5 + ["val"] + ["test"] * 2
+    # non-English / empty rows were skipped: exactly 3 captions per clip
+    assert all(len(v["captions"]) == 3 for v in info["videos"])
+    assert not any("katze" in c for v in info["videos"] for c in v["captions"])
+
+
+def test_msvd_imported_dataset_loads_and_batches(msvd_imported):
+    paths, _ = msvd_imported
+    for split, want in (("train", 5), ("val", 1), ("test", 2)):
+        ds = CaptionDataset(
+            paths["info_json"],
+            {"resnet": paths["resnet"]},
+            split,
+            max_frames=5,
+            consensus_weights=(
+                paths["consensus_weights"] if split == "train" else None
+            ),
+        )
+        assert len(ds) == want
+        batch = next(iter(Batcher(ds, batch_size=2, max_len=12)))
+        assert batch.feats["resnet"].shape == (2, 5, 16)
+        assert batch.labels.max() > 3
+        ds.close()
+
+
+def test_msvd_vocab_is_train_only(msvd_imported):
+    """val/test-only words must encode to <unk> (ADVICE r2: standard
+    train-only preprocessing; the df/weights were already train-restricted)."""
+    paths, _ = msvd_imported
+    info = json.load(open(paths["info_json"]))
+    vocab = set(info["vocab"])
+    train_words = {
+        w for v in info["videos"] if v["split"] == "train"
+        for c in v["captions"] for w in c.split()
+    }
+    test_only = {
+        w for v in info["videos"] if v["split"] != "train"
+        for c in v["captions"] for w in c.split()
+    } - train_words
+    assert test_only, "fixture should exercise unseen test words"
+    assert test_only & vocab == set()
+    df = CorpusDF.load(paths["cider_df"])
+    assert df.num_docs == 5  # train clips only
+
+
+def test_msvd_txt_corpus_and_no_mapping(msvd_fixture, tmp_path):
+    """The AllVideoDescriptions.txt variant, without a mapping: clips order
+    by sorted id and split by the given boundaries."""
+    from cst_captioning_tpu.data.importers import parse_msvd_corpus
+
+    txt = tmp_path / "AllVideoDescriptions.txt"
+    with open(txt, "w") as f:
+        for i in range(4):
+            f.write(f"clip{i} a short caption number {i}\n")
+            f.write(f"clip{i} another sentence about {i}\n")
+    raw, splits = parse_msvd_corpus(str(txt), n_train=2, n_val=1)
+    assert list(raw) == [f"clip{i}" for i in range(4)]
+    assert [splits[c] for c in raw] == ["train", "train", "val", "test"]
+    assert raw["clip0"] == ["a short caption number 0",
+                            "another sentence about 0"]
+
+
+def test_msvd_rejects_undersized_corpus(msvd_fixture, tmp_path):
+    with pytest.raises(ValueError, match="n_train"):
+        import_msvd(msvd_fixture["csv"], str(tmp_path),
+                    mapping=msvd_fixture["mapping"], n_train=100)
+
+
+def test_msvd_cli_entry(msvd_fixture, tmp_path, capsys):
+    from cst_captioning_tpu.cli.import_msvd import main
+
+    main([
+        "--corpus", msvd_fixture["csv"],
+        "--mapping", msvd_fixture["mapping"],
+        "--out-dir", str(tmp_path / "out"),
+        "--feature", f"resnet={msvd_fixture['npy_dir']}",
+        "--n-train", "5", "--n-val", "1",
+        "--min-word-count", "1", "--no-weights",
+    ])
+    paths = json.loads(capsys.readouterr().out)
+    assert os.path.exists(paths["info_json"])
+    assert os.path.exists(paths["resnet"])
+    assert "consensus_weights" not in paths
+
+
+def test_msvd_config1_trains_end_to_end(msvd_imported, tmp_path):
+    """BASELINE config 1 e2e (VERDICT r2 missing #1): the msvd_xe_meanpool
+    preset — dims scaled to the fixture — trains one XE epoch on the
+    IMPORTED MSVD data and validates on its val split."""
+    import dataclasses
+
+    from cst_captioning_tpu.config.presets import get_preset
+    from cst_captioning_tpu.train.trainer import Trainer
+
+    paths, _ = msvd_imported
+    train_ds = CaptionDataset(paths["info_json"], {"resnet": paths["resnet"]},
+                              "train", max_frames=5)
+    val_ds = CaptionDataset(paths["info_json"], {"resnet": paths["resnet"]},
+                            "val", max_frames=5)
+    cfg = get_preset("msvd_xe_meanpool")
+    assert cfg.model.encoder == "meanpool" and cfg.data.dataset == "msvd"
+    cfg = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(
+            cfg.model, vocab_size=len(train_ds.vocab),
+            modalities=(("resnet", 16),), d_embed=16, d_hidden=16,
+            max_len=12, max_frames=5, dtype="float32",
+        ),
+        data=dataclasses.replace(cfg.data, batch_size=4),
+        train=dataclasses.replace(
+            cfg.train, epochs=1, eval_every_epochs=1,
+            ckpt_dir=str(tmp_path / "ckpt"),
+        ),
+    )
+    tr = Trainer(cfg, train_ds, val_ds, use_mesh=False)
+    val = tr.train_xe()
+    assert tr.xe_epochs == 1
+    assert val is not None and np.isfinite(val)
+    train_ds.close()
+    val_ds.close()
 
 
 def test_import_rejects_3d_features(msrvtt_fixture, tmp_path):

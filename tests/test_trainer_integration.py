@@ -86,7 +86,6 @@ def test_xe_overfit_then_rl_improves(datasets, tmp_path_factory):
     assert xe_losses[-1] < xe_losses[0] * 0.75, "XE phase did not learn"
     vals = [e["cider_d"] for e in events if e["event"] == "validate"]
     assert vals, "validation never ran"
-    assert (tmp_path_factory.getbasetemp() / "").exists()
 
     rl_val = tr.train_rl()
     events = [json.loads(l) for l in open(log_path)]
@@ -253,6 +252,52 @@ def test_resume_reproduces_batch_order(datasets, tmp_path_factory):
     # a further resume with the full budget already trained is a no-op
     tr_done = run(d2, total_epochs=2, resume="auto")
     assert int(tr_done.state.step) == int(tr_straight.state.step)
+
+
+def test_rl_resume_reproduces_stream(datasets, tmp_path_factory):
+    """RL twin of test_resume_reproduces_batch_order (VERDICT r2 missing #2):
+    crash mid-RL + rerun the same command == the uninterrupted run,
+    bit-identical params — optimizer moments, step count, per-epoch sampling
+    rng and batch order all continue instead of resetting."""
+    import jax
+
+    train_ds, _ = datasets
+    base = make_cfg("", len(train_ds.vocab), baseline="greedy")
+
+    def run(ckpt_dir, resume="", rl_run_epochs=None):
+        cfg = dataclasses.replace(
+            base,
+            train=dataclasses.replace(
+                base.train, epochs=1, ckpt_dir=ckpt_dir, resume=resume,
+                eval_every_epochs=100,
+            ),
+            rl=dataclasses.replace(base.rl, epochs=2),
+        )
+        tr = Trainer(cfg, train_ds, val_ds=None, use_mesh=False)
+        tr.train_xe()
+        tr.train_rl(rl_run_epochs)
+        return tr
+
+    d1 = str(tmp_path_factory.mktemp("rl_straight"))
+    d2 = str(tmp_path_factory.mktemp("rl_resumed"))
+    tr_straight = run(d1)
+    # "crash" after 1 of the 2 budgeted RL epochs, then rerun the command
+    run(d2, rl_run_epochs=1)
+    tr_resumed = run(d2, resume="auto")
+
+    assert tr_resumed.rl_epochs == tr_straight.rl_epochs == 2
+    assert int(tr_resumed.state.step) == int(tr_straight.state.step)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(tr_straight.state.params),
+        jax.tree_util.tree_leaves(tr_resumed.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the optimizer moments continued too (not re-initialized to zeros)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(tr_straight.state.opt_state),
+        jax.tree_util.tree_leaves(tr_resumed.state.opt_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_resume_logs_config_drift(datasets, tmp_path_factory):
