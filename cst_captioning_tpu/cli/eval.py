@@ -43,11 +43,14 @@ def main(argv: list[str] | None = None) -> None:
     params = load_params(args.ckpt_dir, args.ckpt_name, template)
 
     # shard the decode over all visible devices; the Evaluator wrap-pads any
-    # indivisible batch size up to a device multiple, so no silent fallback
+    # indivisible batch size up to a device multiple, so no silent fallback.
+    # seq_devices>1 carries the training layout into eval: frames shard over
+    # 'seq' (the long-context case where one device can't hold the frame axis)
     n_dev = cfg.mesh.num_devices or len(jax.devices())
     mesh = None
-    if n_dev > 1:
-        mesh = make_mesh(cfg.mesh.num_devices)
+    if n_dev > 1 or cfg.mesh.seq_devices > 1:
+        mesh = make_mesh(cfg.mesh.num_devices,
+                         seq_devices=cfg.mesh.seq_devices)
         params = replicate(mesh, params)
 
     result = evaluate_split(

@@ -113,7 +113,9 @@ class TrainConfig:
     weight_decay: float = 0.0
     label_smoothing: float = 0.0
     loss: str = "xe"                    # "xe" | "wxe"
-    log_every: int = 50
+    # per-step JSONL events (loss/reward + grad_norm every N steps; 0 = off,
+    # keeping logs to per-epoch summaries)
+    log_every_steps: int = 0
     eval_every_epochs: int = 1
     ckpt_dir: str = "checkpoints"
     resume: str = ""                    # "", "auto", or explicit ckpt path
@@ -162,6 +164,10 @@ class MeshConfig:
 
     data_axis: str = "data"
     num_devices: int = 0                # 0 = all visible devices
+    # >1: 2-D ('data','seq') mesh — the FRAME axis shards over 'seq' with the
+    # collective attention softmax (long-context path, SURVEY.md §5); must
+    # divide num_devices and model.max_frames
+    seq_devices: int = 1
 
 
 @dataclass(frozen=True)

@@ -16,13 +16,14 @@ from typing import Any
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cst_captioning_tpu.config.config import EvalConfig
 from cst_captioning_tpu.data.batcher import Batcher
 from cst_captioning_tpu.data.dataset import CaptionDataset
 from cst_captioning_tpu.decoding import beam_search, greedy_decode
 from cst_captioning_tpu.metrics.scorer import CaptionScorer
+from cst_captioning_tpu.parallel import sp_batch_specs, sp_model
 from cst_captioning_tpu.train.mesh import batch_sharding
 from cst_captioning_tpu.train.steps import batch_arrays
 
@@ -47,42 +48,71 @@ class Evaluator:
         self.ds = dataset
         self.cfg = cfg or EvalConfig()
         self.mesh = mesh
+        # 2-D ('data','seq') mesh: frames shard over 'seq' with the SP
+        # collective attention (MeshConfig.seq_devices > 1)
+        self.sp = mesh is not None and "seq" in mesh.axis_names
         if mesh is not None:
-            # every batch size shards: round up to the next device multiple —
-            # the Batcher wrap-pads to the (static) batch size and marks the
+            # every batch size shards: round up to the next data-axis multiple
+            # — the Batcher wrap-pads to the (static) batch size and marks the
             # extra rows invalid, so generate() drops them and the captions
             # stay exactly the single-device ones (VERDICT r2 next #5)
-            n = mesh.devices.size
+            n = mesh.shape["data"]
             if batch_size % n:
                 padded = -(-batch_size // n) * n
                 # warning level: visible under the default root-logger config
                 logging.getLogger(__name__).warning(
-                    "eval batch_size %d -> %d (next multiple of %d devices; "
-                    "wrap-padded rows are masked out)", batch_size, padded, n,
+                    "eval batch_size %d -> %d (next multiple of the %d-device "
+                    "'data' axis; wrap-padded rows are masked out)",
+                    batch_size, padded, n,
                 )
                 batch_size = padded
+            if self.sp and dataset.max_frames % mesh.shape["seq"]:
+                raise ValueError(
+                    f"dataset max_frames {dataset.max_frames} must be "
+                    f"divisible by the mesh's 'seq' axis {mesh.shape['seq']}"
+                )
         self.batcher = Batcher(
             dataset, batch_size=batch_size, max_len=self.cfg.max_len, mode="video"
         )
         W, T, lp = self.cfg.beam_size, self.cfg.max_len, self.cfg.length_penalty
         ml = self.cfg.min_len
 
+        dec_model = model
+        if self.sp and not model.cfg.seq_axis:
+            dec_model = sp_model(model.cfg)  # params are layout-identical
         if W > 1:
             decode = lambda p, f, m: beam_search(
-                model, p, f, m, beam_size=W, max_len=T, min_len=ml,
+                dec_model, p, f, m, beam_size=W, max_len=T, min_len=ml,
                 length_penalty=lp,
             )[0]
         else:
             decode = lambda p, f, m: greedy_decode(
-                model, p, f, m, max_len=T, min_len=ml
+                dec_model, p, f, m, max_len=T, min_len=ml
             )[0]
+        self._fm_shardings = None
         if mesh is not None:
+            if self.sp:
+                f_spec, m_spec = sp_batch_specs(model.cfg, "data")
+                in_specs = (P(), f_spec, m_spec)
+                self._fm_shardings = (
+                    {k: NamedSharding(mesh, s) for k, s in f_spec.items()},
+                    {k: NamedSharding(mesh, s) for k, s in m_spec.items()},
+                )
+            else:
+                in_specs = (P(), P("data"), P("data"))
+                s = batch_sharding(mesh)
+                self._fm_shardings = (s, s)
             decode = jax.shard_map(
                 decode,
                 mesh=mesh,
-                in_specs=(P(), P("data"), P("data")),
+                in_specs=in_specs,
                 out_specs=P("data"),
-                # decode is collective-free; see make_parallel_rl_decode
+                # INVARIANT (tracked, VERDICT r2 weak #3): decode must stay
+                # collective-free over 'data' (the scan carry varies per batch
+                # shard while its BOS init does not) — see
+                # make_parallel_rl_decode's note; the SP 'seq' psums still
+                # execute correctly with the check off. Exactness tests in
+                # tests/test_ckpt_eval.py are the backstop.
                 check_vma=False,
             )
         self._decode = jax.jit(decode)
@@ -90,11 +120,12 @@ class Evaluator:
     def generate(self, params) -> dict[str, str]:
         """Decode every video of the split -> {video_id: caption string}."""
         out: dict[str, str] = {}
-        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
         for batch in self.batcher.epoch(shuffle=False):
             feats, masks, *_ = batch_arrays(batch)
-            if sharding is not None:
-                feats, masks = jax.device_put((feats, masks), sharding)
+            if self._fm_shardings is not None:
+                feats, masks = jax.device_put(
+                    (feats, masks), self._fm_shardings
+                )
             tokens = np.asarray(self._decode(params, feats, masks))
             for i, ok in enumerate(batch.valid):
                 if ok:

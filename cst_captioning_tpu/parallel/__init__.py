@@ -10,7 +10,9 @@ lives frame-sharded across the mesh and the only frame-crossing reductions
 from cst_captioning_tpu.parallel.seq_parallel import (
     make_sp_decode,
     make_sp_forward,
+    make_sp_rl_update,
     make_sp_xe_step,
+    sp_batch_shardings,
     sp_batch_specs,
     sp_model,
 )
@@ -18,7 +20,9 @@ from cst_captioning_tpu.parallel.seq_parallel import (
 __all__ = [
     "make_sp_decode",
     "make_sp_forward",
+    "make_sp_rl_update",
     "make_sp_xe_step",
+    "sp_batch_shardings",
     "sp_batch_specs",
     "sp_model",
 ]

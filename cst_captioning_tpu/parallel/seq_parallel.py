@@ -30,7 +30,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cst_captioning_tpu.config.config import ModelConfig
 from cst_captioning_tpu.decoding import greedy_decode, sample_decode
@@ -82,15 +82,21 @@ def make_sp_forward(model: CaptionModel, mesh: Mesh, data_axis: str = "",
 
 def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
                    temperature: float = 1.0, max_len: int | None = None,
-                   seq_axis: str = "seq") -> Callable:
+                   seq_axis: str = "seq", data_axis: str = "") -> Callable:
     """Jitted SP decode: (params, feats, masks, rng) -> (greedy, samples|None).
 
-    The long-video RL/eval decode: frames sharded, batch replicated. With
+    The long-video RL/eval decode: frames sharded over ``seq_axis``; the
+    batch replicates, or shards over ``data_axis`` when given (DP x SP —
+    the product layout for ``MeshConfig.seq_devices > 1``). With
     ``num_rollouts=0`` only the greedy decode runs (eval path).
     """
-    f_spec, m_spec = sp_batch_specs(model.cfg, "", seq_axis)
+    f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
+    b = data_axis if data_axis else None
 
     def dec(params, feats, masks, rng):
+        if data_axis:
+            # independent sampling streams per batch shard
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(data_axis))
         greedy, _ = greedy_decode(model, params, feats, masks, max_len=max_len)
         if num_rollouts:
             samples, _ = sample_decode(
@@ -102,11 +108,20 @@ def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
             samples = greedy  # stable output structure for jit
         return greedy, samples
 
+    extra = {}
+    if data_axis:
+        # INVARIANT (see make_parallel_rl_decode): with the batch sharded the
+        # scan carry varies over 'data' while its BOS init does not, so the
+        # varying-axis check must be off. The 'seq' collectives inside the
+        # attention still execute correctly — check_vma only disables the
+        # type-level invariance analysis, not the psums.
+        extra["check_vma"] = False
     sharded = jax.shard_map(
         dec,
         mesh=mesh,
         in_specs=(P(), f_spec, m_spec, P()),
-        out_specs=(P(), P()),
+        out_specs=(P(b), P(None, b) if num_rollouts else P(b)),
+        **extra,
     )
     return jax.jit(sharded)
 
@@ -126,6 +141,13 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
     def sharded_loss(params, feats, masks, labels, mask, weights, drng):
         if data_axis:
             drng = jax.random.fold_in(drng, jax.lax.axis_index(data_axis))
+        # the seq index is deliberately NOT folded in (ADVICE r2 reviewed and
+        # declined): every dropout site sits on the REPLICATED path (the
+        # decoder input/hidden, downstream of the attention psum — there is
+        # no frame-sharded dropout in this model), so identical masks across
+        # 'seq' devices are what keep the replicated activations replicated;
+        # folding the seq index would desynchronize them and break the
+        # out_specs invariance.
         logits = model.apply(
             params, feats, masks, labels, train=True, rngs={"dropout": drng}
         )
@@ -160,3 +182,70 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
         return state, {"loss": loss, "grad_norm": gnorm}
 
     return step
+
+
+def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
+                      seq_axis: str = "seq") -> Callable:
+    """Jitted DP x SP REINFORCE update (the SCST update on a 2-D mesh).
+
+    Same structure as :func:`make_sp_xe_step`: the loss (teacher-forced
+    logprobs of the sampled rollouts, advantage-weighted, psum-normalized
+    over ``data_axis``) is computed inside shard_map; ``value_and_grad``
+    wraps the whole sharded computation so the 'seq' attention collectives
+    transpose to exact global gradients. Mirrors rl/scst.py's
+    ``make_parallel_rl_update`` semantics (valid-row exclusion included).
+    """
+    f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
+    b = data_axis if data_axis else None
+
+    def sharded_loss(params, feats, masks, samples, advantage, valid):
+        # the single source of truth for tiling + REINFORCE loss sums lives
+        # in rl/scst.py (import here: scst's own parallel import is lazy, so
+        # there is no module-level cycle)
+        from cst_captioning_tpu.rl.scst import _rl_loss_sums, _tile_feats
+
+        K, Bl, T = samples.shape
+        feats_f, masks_f = _tile_feats(feats, masks, K)
+        num, den = _rl_loss_sums(
+            model, params, feats_f, masks_f,
+            samples.reshape(K * Bl, T),
+            advantage.reshape(K * Bl),
+            jnp.tile(valid, (K,)),
+        )
+        if data_axis:
+            num = jax.lax.psum(num, data_axis)
+            den = jax.lax.psum(den, data_axis)
+        return num / jnp.maximum(den, 1.0)
+
+    sm = jax.shard_map(
+        sharded_loss,
+        mesh=mesh,
+        in_specs=(P(), f_spec, m_spec, P(None, b), P(None, b), P(b)),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def update(state: TrainState, feats, masks, samples, advantage, valid):
+        def loss_fn(p):
+            return sm(p, feats, masks, samples, advantage, valid)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        gnorm = optax.global_norm(grads)
+        state = state.apply_gradients(grads)
+        return state, {"rl_loss": loss, "grad_norm": gnorm}
+
+    return update
+
+
+def sp_batch_shardings(mesh: Mesh, cfg: ModelConfig, data_axis: str = "data",
+                       seq_axis: str = "seq") -> tuple:
+    """``jax.device_put`` shardings for the XE batch tuple
+    ``(feats, masks, labels, mask, weights, valid)`` on a 2-D mesh:
+    frame axis over ``seq_axis``, batch axis over ``data_axis``."""
+    f_spec, m_spec = sp_batch_specs(cfg, data_axis, seq_axis)
+    d = NamedSharding(mesh, P(data_axis))
+    return (
+        {k: NamedSharding(mesh, s) for k, s in f_spec.items()},
+        {k: NamedSharding(mesh, s) for k, s in m_spec.items()},
+        d, d, d, d,
+    )

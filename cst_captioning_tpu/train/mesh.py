@@ -17,10 +17,21 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def make_mesh(num_devices: int = 0, axis: str = "data") -> Mesh:
+def make_mesh(num_devices: int = 0, axis: str = "data",
+              seq_devices: int = 1, seq_axis: str = "seq") -> Mesh:
+    """1-D ``(data,)`` mesh, or 2-D ``(data, seq)`` when ``seq_devices > 1``
+    (the long-context layout: batch over 'data', frames over 'seq')."""
     devices = jax.devices()
     if num_devices:
         devices = devices[:num_devices]
+    if seq_devices > 1:
+        n = len(devices)
+        if n % seq_devices:
+            raise ValueError(
+                f"seq_devices {seq_devices} must divide the {n} mesh devices"
+            )
+        grid = np.asarray(devices).reshape(n // seq_devices, seq_devices)
+        return Mesh(grid, (axis, seq_axis))
     return Mesh(np.asarray(devices), (axis,))
 
 

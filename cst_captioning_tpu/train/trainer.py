@@ -25,6 +25,11 @@ from cst_captioning_tpu.data.prefetch import prefetch_to_device
 from cst_captioning_tpu.eval.evaluator import Evaluator
 from cst_captioning_tpu.metrics.cider import CorpusDF
 from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.parallel import (
+    make_sp_xe_step,
+    sp_batch_shardings,
+    sp_model,
+)
 from cst_captioning_tpu.rl import RewardComputer, SCSTTrainer
 from cst_captioning_tpu.train.mesh import batch_sharding, make_mesh, replicate
 from cst_captioning_tpu.train.schedule import make_optimizer
@@ -38,7 +43,9 @@ from cst_captioning_tpu.utils.profiling import StepProfiler
 # resumed one; excluded from drift detection so the alert stays meaningful
 _VOLATILE_CONFIG_FIELDS = frozenset({
     "train.resume", "train.ckpt_dir", "train.profile_dir",
-    "train.profile_steps", "train.debug_nans", "eval.results_json",
+    "train.profile_steps", "train.debug_nans", "train.log_every_steps",
+    "train.log_every",  # pre-rename snapshots carry the old field name
+    "eval.results_json",
 })
 
 
@@ -77,17 +84,31 @@ class Trainer:
             jax.config.update("jax_debug_nans", True)
 
         n_dev = cfg.mesh.num_devices or len(jax.devices())
-        self.use_mesh = (n_dev > 1) if use_mesh is None else use_mesh
-        self.mesh = make_mesh(cfg.mesh.num_devices) if self.use_mesh else None
-        if self.mesh is not None and cfg.data.batch_size % self.mesh.devices.size:
-            # unlike eval (which wrap-pads exactly, evaluator.py), padding a
-            # TRAINING batch would change how rows group into optimizer steps
-            # — fail early with guidance instead of a device_put shape error
-            raise ValueError(
-                f"training batch_size {cfg.data.batch_size} must be divisible "
-                f"by the {self.mesh.devices.size}-device mesh; pick a multiple "
-                "or set mesh.num_devices"
-            )
+        sp = cfg.mesh.seq_devices > 1
+        self.use_mesh = (n_dev > 1 or sp) if use_mesh is None else use_mesh
+        self.mesh = (
+            make_mesh(cfg.mesh.num_devices, seq_devices=cfg.mesh.seq_devices)
+            if self.use_mesh else None
+        )
+        # 2-D ('data','seq') mesh: batch shards over 'data', the FRAME axis
+        # over 'seq' (collective attention softmax — the long-context layout)
+        self.sp = self.mesh is not None and "seq" in self.mesh.axis_names
+        if self.mesh is not None:
+            n_data = self.mesh.shape["data"]
+            if cfg.data.batch_size % n_data:
+                # unlike eval (which wrap-pads exactly, evaluator.py), padding
+                # a TRAINING batch would change how rows group into optimizer
+                # steps — fail early with guidance, not a device_put error
+                raise ValueError(
+                    f"training batch_size {cfg.data.batch_size} must be "
+                    f"divisible by the mesh's {n_data}-device 'data' axis; "
+                    "pick a multiple or set mesh.num_devices/seq_devices"
+                )
+            if self.sp and cfg.model.max_frames % self.mesh.shape["seq"]:
+                raise ValueError(
+                    f"model.max_frames {cfg.model.max_frames} must be "
+                    f"divisible by mesh.seq_devices {self.mesh.shape['seq']}"
+                )
 
         self.batcher = Batcher(
             train_ds,
@@ -106,9 +127,17 @@ class Trainer:
         )
         if self.mesh is not None:
             self.state = replicate(self.mesh, self.state)
-            self.xe_step = make_parallel_xe_step(
-                self.model, self.mesh, cfg.train.label_smoothing
-            )
+            if self.sp:
+                # SP params are layout-identical to the plain model's, so the
+                # state init above (plain model) feeds the SP step directly
+                self.xe_step = make_sp_xe_step(
+                    sp_model(cfg.model), self.mesh, cfg.train.label_smoothing,
+                    data_axis="data",
+                )
+            else:
+                self.xe_step = make_parallel_xe_step(
+                    self.model, self.mesh, cfg.train.label_smoothing
+                )
         else:
             self.xe_step = make_xe_step(self.model, cfg.train.label_smoothing)
 
@@ -177,12 +206,21 @@ class Trainer:
 
     # ---- phases ------------------------------------------------------------
 
+    def _batch_sharding(self):
+        """device_put target for the XE batch tuple: a single axis-0 sharding
+        (1-D mesh; a tree prefix for every element), or the per-leaf SP tuple
+        (frames over 'seq', batch over 'data')."""
+        if self.mesh is None:
+            return None
+        if self.sp:
+            return sp_batch_shardings(self.mesh, self.cfg.model)
+        return batch_sharding(self.mesh)
+
     def _device_batches(self, batcher: Batcher):
-        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
         yield from prefetch_to_device(
             batcher.epoch(),
             size=self.cfg.data.prefetch,
-            sharding=sharding,
+            sharding=self._batch_sharding(),
             # valid rides along so wrap-padded duplicate rows get zero weight
             transform=lambda b: batch_arrays(b)
             + (jax.numpy.asarray(b.valid, jax.numpy.float32),),
@@ -191,7 +229,9 @@ class Trainer:
     def _rl_device_batches(self, batcher: Batcher):
         """Prefetched RL batches: arrays staged to device (sharded when a mesh
         is in play), video ids + valid mask staying host-side for the reward."""
-        sharding = batch_sharding(self.mesh) if self.mesh is not None else None
+        sharding = self._batch_sharding()
+        if sharding is not None and self.sp:
+            sharding = (sharding[0], sharding[1])  # (feats, masks) only
 
         def transform(b):
             feats, masks, *_ = batch_arrays(b)
@@ -227,6 +267,7 @@ class Trainer:
         last_val = None
         weighted = cfg.train.loss == "wxe"
         first_step = True
+        log_every = cfg.train.log_every_steps
         for _ in range(epochs):
             timer.reset()
             losses = []
@@ -238,6 +279,17 @@ class Trainer:
                     self.state, feats, masks, labels, mask, weights
                 )
                 losses.append(float(m["loss"]))
+                if log_every and int(self.state.step) % log_every == 0:
+                    # per-step event: a mid-epoch divergence (NaN, grad blowup)
+                    # is locatable from the log alone (SURVEY.md §5)
+                    self.log.log(
+                        "xe_step",
+                        phase="xe",
+                        step=int(self.state.step),
+                        epoch=self.epoch + 1,
+                        loss=float(m["loss"]),
+                        grad_norm=float(m["grad_norm"]),
+                    )
                 profiler.tick()
                 if first_step:
                     # exclude jit-compile time from the throughput meter
@@ -317,12 +369,25 @@ class Trainer:
             cfg.train.profile_steps,
         )
         last_val = None
+        log_every = cfg.train.log_every_steps
+        step_counter = {"step": int(self.state.step)}
         for _ in range(epochs):
             timer.reset()
             rewards = []
 
             def on_step(m):
                 rewards.append(m["reward_mean"])
+                step_counter["step"] += 1
+                if log_every and step_counter["step"] % log_every == 0:
+                    self.log.log(
+                        "rl_step",
+                        phase="rl",
+                        step=step_counter["step"],
+                        epoch=self.epoch + 1,
+                        reward=float(m["reward_mean"]),
+                        rl_loss=float(m["rl_loss"]),
+                        grad_norm=float(m["grad_norm"]),
+                    )
                 profiler.tick()
                 if len(rewards) == 1:
                     timer.reset()  # exclude jit-compile time of the first step
