@@ -20,8 +20,17 @@ target under this assumption"; the assumption is carried in the JSON
 (``assumed_reference_clips_per_sec``) so it cannot be misread as a measured
 baseline. Replace the constant when the reference becomes readable.
 
-Usage: python bench.py [--profile DIR] [--batch N] [--steps N]
+Beyond the headline clips/s/chip, the JSON reports (VERDICT r2 next #3):
+  - ``flops_per_clip`` / ``mfu``  — XLA-measured FLOPs (cost_analysis of the
+    compiled decode+update programs) against the chip's peak bf16 rate;
+  - ``time_shares``               — strict-sequential wall shares of
+    decode / host reward / update, showing where the non-MXU time goes
+    (the pipelined epoch then overlaps the reward share with device work).
+
+Usage: python bench.py [--profile DIR] [--batch N] [--steps N] [--chunks C]
   --profile DIR  write a jax.profiler trace of the measured steps to DIR
+  --chunks C     rl.update_chunks: gradient accumulation over the rollout
+                 axis (C divides K=5) — lifts the HBM ceiling on batch size
 """
 
 from __future__ import annotations
@@ -36,16 +45,85 @@ import numpy as np
 ASSUMED_REFERENCE_CLIPS_PER_SEC = 100.0   # 2017 single-GPU estimate (see above)
 TARGET_MULTIPLIER = 3.0
 
-# B=512 saturates the v5e chip without OOM (1024 exceeds HBM: the REINFORCE
-# update teacher-forces K*B sequences); swept in round 2: 64->260, 128->525,
-# 256->865, 512->1336 clips/s pipelined.
-BATCH = 512
+# The fused update teacher-forces K*B sequences at once, capping the batch at
+# B=512 on a 16G v5e chip (B=1024 fused: "Used 18.84G of 15.75G hbm");
+# update_chunks=5 accumulates gradients per rollout, lifting the ceiling.
+# Round-3 sweep on TPU v5e (chunks=5, pipelined): 1024->2074, 1536->2368,
+# 1792->2406, 2048->220 (past the knee: HBM spill collapse). Fused round-2
+# sweep for reference: 64->260, 128->525, 256->865, 512->1341.
+BATCH = 1792
+DEFAULT_CHUNKS = 5
 FRAMES = 20
 MAX_LEN = 30
 K_ROLLOUTS = 5
 VOCAB = 9000
 MEASURE_STEPS = 8
 WARMUP_STEPS = 2
+
+# peak dense bf16 FLOP/s per chip by device kind (public TPU specs); the
+# match is substring-based and the assumed value is carried in the JSON
+PEAK_BF16_FLOPS = (
+    ("v6e", 918e12), ("v6 lite", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+)
+DEFAULT_PEAK = 197e12
+
+
+def _peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for frag, peak in PEAK_BF16_FLOPS:
+        if frag in kind:
+            return peak
+    return DEFAULT_PEAK
+
+
+def _xla_flops(jitted, *args) -> float:
+    """FLOPs of one invocation per XLA's compiled-program cost analysis.
+
+    CAVEAT: XLA counts while/scan BODIES ONCE, not times their trip count,
+    so programs dominated by the T-step decode scan undercount by ~T; kept
+    in the JSON for reference only — MFU uses the analytic count below.
+    Returns NaN when the backend doesn't expose the analysis.
+    """
+    try:
+        analysis = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        return float(analysis["flops"])
+    except Exception as e:  # pragma: no cover - backend-specific surface
+        print(f"bench: cost_analysis unavailable ({e!r})", file=sys.stderr)
+        return float("nan")
+
+
+def _analytic_flops_per_clip(
+    K=K_ROLLOUTS, T=MAX_LEN, F=FRAMES, d=512, d_att=256, V=VOCAB,
+    feat_dims=(2048, 500),
+) -> float:
+    """Matmul FLOPs (2*m*n*k) of one SCST step per clip, from the flagship
+    dims: per-modality frame embeddings + attention key projection once per
+    forward pass, then per decoded/teacher-forced token the attention
+    (query proj, scores, context sum over the M=2F concat memory), the
+    input-feed LSTM (in = word d + ctx d), and the d->V output projection.
+    Decode runs the encoder once each for the greedy and sampling programs
+    (sample_decode shares one encode across rollouts) and steps 1+K rows per
+    clip; the update teacher-forces K TILED copies (encoder included, see
+    scst._tile_feats) with a backward pass (~2x forward). Elementwise /
+    softmax work is ignored (matmul-dominated).
+    """
+    M = len(feat_dims) * F
+    enc = 2 * F * sum(feat_dims) * d + 2 * M * d * d_att
+    per_tok = (
+        2 * d * d_att          # attention query projection
+        + 2 * M * d_att        # scores
+        + 2 * M * d            # context weighted sum
+        + 2 * 4 * d * (3 * d)  # LSTM: 4 gates x (input 2d [word+ctx] + hidden d)
+        + 2 * d * V            # output projection
+    )
+    decode = 2 * enc + (1 + K) * T * per_tok
+    update = 3 * K * (enc + T * per_tok)
+    return float(decode + update)
 
 
 def main() -> None:
@@ -54,8 +132,18 @@ def main() -> None:
                     help="write a jax.profiler trace of the measured steps")
     ap.add_argument("--batch", type=int, default=BATCH)
     ap.add_argument("--steps", type=int, default=MEASURE_STEPS)
+    ap.add_argument("--chunks", type=int, default=DEFAULT_CHUNKS,
+                    help="rl.update_chunks (divides K=5; 1 = fused — the "
+                         "fused update OOMs above --batch 512 on a 16G chip)")
     args = ap.parse_args()
     batch_size, measure_steps = args.batch, args.steps
+    if args.chunks == 1 and batch_size > 512:
+        # fail before the multi-minute warmup compile, not after it
+        sys.exit(
+            f"bench: --chunks 1 (fused update) OOMs above --batch 512 on a "
+            f"16G v5e (B=1024 needed 18.84G of 15.75G HBM); got --batch "
+            f"{batch_size}. Pass --batch 512 or keep chunking."
+        )
 
     import jax
     import jax.numpy as jnp
@@ -105,7 +193,8 @@ def main() -> None:
         for v in vids
     }
     reward = RewardComputer(vocab, gts, cider_weight=1.0, bleu_weight=0.5)
-    rl_cfg = RLConfig(enabled=True, num_rollouts=K_ROLLOUTS, baseline="greedy")
+    rl_cfg = RLConfig(enabled=True, num_rollouts=K_ROLLOUTS, baseline="greedy",
+                      update_chunks=args.chunks)
     scst = SCSTTrainer(model, reward, rl_cfg, max_len=MAX_LEN)
 
     def batches(n):
@@ -137,7 +226,62 @@ def main() -> None:
     target = ASSUMED_REFERENCE_CLIPS_PER_SEC * TARGET_MULTIPLIER
     print(
         f"bench: {measure_steps} steps in {dt:.2f}s -> {per_chip:.1f} clips/s/chip "
-        f"(K={K_ROLLOUTS} rollouts, B={batch_size}, T={MAX_LEN}, pipelined)",
+        f"(K={K_ROLLOUTS} rollouts, B={batch_size}, T={MAX_LEN}, pipelined, "
+        f"chunks={args.chunks})",
+        file=sys.stderr,
+    )
+
+    # ---- diagnostics: XLA FLOPs -> MFU, strict-sequential phase shares -----
+    key2 = jax.random.key(1)
+    decode_flops = _xla_flops(scst.decode, state.params, feats, masks, key2)
+    greedy, samples = scst.decode(state.params, feats, masks, key2)
+    jax.block_until_ready(samples)
+    samples_np = np.asarray(samples)
+    greedy_np = np.asarray(greedy)
+    valid_np = np.ones((batch_size,), np.float32)
+    advantage, _ = scst._advantage(greedy_np, samples_np, vids, valid_np)
+    adv_dev = jnp.asarray(advantage, jnp.float32)
+    valid_dev = jnp.asarray(valid_np)
+    update_flops = _xla_flops(
+        scst.update, state, feats, masks, samples, adv_dev, valid_dev
+    )
+
+    t0 = time.perf_counter()
+    for _ in range(measure_steps):
+        g, s = scst.decode(state.params, feats, masks, key2)
+    jax.block_until_ready(s)
+    dt_decode = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(measure_steps):
+        scst._advantage(greedy_np, samples_np, vids, valid_np)
+    dt_reward = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    ustate = state
+    for _ in range(measure_steps):
+        ustate, _ = scst.update(
+            ustate, feats, masks, samples, adv_dev, valid_dev
+        )
+    jax.block_until_ready(ustate.params)
+    dt_update = time.perf_counter() - t0
+
+    seq_total = dt_decode + dt_reward + dt_update
+    shares = {
+        "decode": round(dt_decode / seq_total, 3),
+        "reward": round(dt_reward / seq_total, 3),
+        "update": round(dt_update / seq_total, 3),
+    }
+    flops_per_clip = _analytic_flops_per_clip()
+    xla_flops_per_clip = (decode_flops + update_flops) / batch_size
+    kind = jax.devices()[0].device_kind
+    peak = _peak_flops(kind)
+    mfu = flops_per_clip * batch_size * measure_steps / dt / peak / max(n_chips, 1)
+    print(
+        f"bench: seq shares decode={shares['decode']} reward={shares['reward']} "
+        f"update={shares['update']} (pipelining overlaps the reward); "
+        f"{flops_per_clip / 1e9:.2f} GFLOP/clip analytic, mfu={mfu:.4f} "
+        f"of {peak / 1e12:.0f}TF peak ({kind})",
         file=sys.stderr,
     )
     print(
@@ -151,6 +295,22 @@ def main() -> None:
                 "target_multiplier": TARGET_MULTIPLIER,
                 "batch": batch_size,
                 "rollouts": K_ROLLOUTS,
+                "update_chunks": args.chunks,
+                "flops_per_clip_analytic": round(flops_per_clip),
+                # XLA cost_analysis, scan bodies counted ONCE (see _xla_flops)
+                "flops_per_clip_xla_uncorrected": (
+                    None if np.isnan(xla_flops_per_clip)
+                    else round(xla_flops_per_clip)
+                ),
+                "mfu": None if np.isnan(mfu) else round(mfu, 4),
+                "device_kind": kind,
+                "assumed_peak_bf16_flops": peak,
+                "time_shares_sequential": shares,
+                "seq_seconds": {
+                    "decode": round(dt_decode, 3),
+                    "reward": round(dt_reward, 3),
+                    "update": round(dt_update, 3),
+                },
             }
         )
     )

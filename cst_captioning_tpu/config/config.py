@@ -138,6 +138,12 @@ class RLConfig:
     lr: float = 2e-5                    # RL phase LR (fresh optimizer on handoff)
     epochs: int = 20
     init_from: str = ""                 # XE checkpoint to start from
+    # gradient accumulation over the K rollout axis in the REINFORCE update:
+    # the update teacher-forces K*B sequences at once, which caps the batch
+    # size under HBM; update_chunks=C (dividing K) re-runs forward+backward
+    # on K/C rollouts at a time — the same total gradient up to float
+    # summation order, NOT bit-equal to the fused path (1 = fused)
+    update_chunks: int = 1
 
 
 @dataclass(frozen=True)

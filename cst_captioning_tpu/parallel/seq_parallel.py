@@ -185,20 +185,24 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
 
 
 def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
-                      seq_axis: str = "seq") -> Callable:
+                      seq_axis: str = "seq", chunks: int = 1) -> Callable:
     """Jitted DP x SP REINFORCE update (the SCST update on a 2-D mesh).
 
-    Same structure as :func:`make_sp_xe_step`: the loss (teacher-forced
-    logprobs of the sampled rollouts, advantage-weighted, psum-normalized
-    over ``data_axis``) is computed inside shard_map; ``value_and_grad``
-    wraps the whole sharded computation so the 'seq' attention collectives
-    transpose to exact global gradients. Mirrors rl/scst.py's
-    ``make_parallel_rl_update`` semantics (valid-row exclusion included).
+    Same structure as :func:`make_sp_xe_step`: the (numerator, denominator)
+    sums of the teacher-forced REINFORCE loss are computed inside shard_map
+    (psum'd over ``data_axis``); ``value_and_grad`` wraps the whole sharded
+    computation so the 'seq' attention collectives transpose to exact global
+    gradients. Mirrors rl/scst.py's ``make_parallel_rl_update`` semantics
+    (valid-row exclusion included). ``chunks > 1`` scans over slices of the
+    rollout axis at the jit level — one value_and_grad per chunk, gradients
+    accumulated, normalized once by the global token count — producing the
+    same total gradient in K/chunks of the activation memory (the same
+    HBM-ceiling lever as ``rl.update_chunks`` on the 1-D mesh).
     """
     f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
     b = data_axis if data_axis else None
 
-    def sharded_loss(params, feats, masks, samples, advantage, valid):
+    def sharded_sums(params, feats, masks, samples, advantage, valid):
         # the single source of truth for tiling + REINFORCE loss sums lives
         # in rl/scst.py (import here: scst's own parallel import is lazy, so
         # there is no module-level cycle)
@@ -215,21 +219,43 @@ def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
         if data_axis:
             num = jax.lax.psum(num, data_axis)
             den = jax.lax.psum(den, data_axis)
-        return num / jnp.maximum(den, 1.0)
+        return num, den
 
     sm = jax.shard_map(
-        sharded_loss,
+        sharded_sums,
         mesh=mesh,
         in_specs=(P(), f_spec, m_spec, P(None, b), P(None, b), P(b)),
-        out_specs=P(),
+        out_specs=(P(), P()),
     )
 
     @jax.jit
     def update(state: TrainState, feats, masks, samples, advantage, valid):
-        def loss_fn(p):
-            return sm(p, feats, masks, samples, advantage, valid)
+        K = samples.shape[0]
+        if chunks > 1:
+            from cst_captioning_tpu.rl.scst import accumulate_chunk_grads
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            if K % chunks:
+                raise ValueError(
+                    f"update_chunks {chunks} must divide K={K} rollouts"
+                )
+            kc = K // chunks
+            sam = samples.reshape((chunks, kc) + samples.shape[1:])
+            adv = advantage.reshape((chunks, kc) + advantage.shape[1:])
+            # this scan sits OUTSIDE the shard_map (global arrays), so no
+            # vary_axis is needed on the carry
+            num, den, g_sum = accumulate_chunk_grads(
+                lambda p, sam_c, adv_c: sm(p, feats, masks, sam_c, adv_c, valid),
+                state.params, (sam, adv),
+            )
+            den = jnp.maximum(den, 1.0)
+            loss = num / den
+            grads = jax.tree.map(lambda g: g / den, g_sum)
+        else:
+            def loss_fn(p):
+                num, den = sm(p, feats, masks, samples, advantage, valid)
+                return num / jnp.maximum(den, 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
         state = state.apply_gradients(grads)
         return state, {"rl_loss": loss, "grad_norm": gnorm}

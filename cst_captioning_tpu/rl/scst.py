@@ -116,24 +116,96 @@ def _tile_feats(feats, masks, K):
     )
 
 
-def make_rl_update(model) -> Callable:
-    """Jitted: (state, feats, masks, samples [K,B,T], adv [K,B]) -> (state, metrics)."""
+def accumulate_chunk_grads(sums_fn, params, xs, vary_axis: str | None = None):
+    """``lax.scan`` of ``value_and_grad(sums_fn)`` over leading-axis chunks.
+
+    ``sums_fn(params, *chunk)`` returns the ``(num, den)`` loss sums of one
+    chunk; per-chunk gradients of the un-normalized numerator are
+    accumulated, and the caller divides once by the total denominator (which
+    is parameter-independent). The total gradient therefore equals the fused
+    computation up to float summation order while only one chunk's
+    activations are ever live — the shared engine of ``rl.update_chunks``
+    (used here and by parallel/seq_parallel.py's SP update).
+
+    Returns ``(num_total, den_total, grad_sums)``.
+    """
+
+    def body(acc, x):
+        g_acc, num_acc, den_acc = acc
+        (num, den), g = jax.value_and_grad(sums_fn, has_aux=True)(params, *x)
+        return (
+            jax.tree.map(jnp.add, g_acc, g), num_acc + num, den_acc + den
+        ), None
+
+    init = (
+        jax.tree.map(jnp.zeros_like, params), jnp.zeros(()), jnp.zeros(())
+    )
+    if vary_axis is not None:
+        # inside shard_map the per-chunk sums vary over the batch axis; the
+        # scan carry init must carry the same varying-axis type
+        init = jax.tree.map(
+            lambda x: jax.lax.pcast(x, vary_axis, to="varying"), init
+        )
+    (g_sum, num, den), _ = jax.lax.scan(body, init, xs)
+    return num, den, g_sum
+
+
+def _chunked_loss_grads(model, params, feats, masks, samples, advantage,
+                        valid, chunks: int, vary_axis: str | None = None):
+    """REINFORCE loss sums + gradients, accumulated over ``chunks`` slices
+    of the K rollout axis.
+
+    Teacher-forcing all K*B sequences at once is the HBM ceiling on batch
+    size (VERDICT r2 weak #1); chunking bounds the live activation footprint
+    to K/chunks rollouts — see :func:`accumulate_chunk_grads`.
+    """
+    K, B, T = samples.shape
+    if K % chunks:
+        raise ValueError(f"update_chunks {chunks} must divide K={K} rollouts")
+    kc = K // chunks
+    feats_f, masks_f = _tile_feats(feats, masks, kc)
+    valid_f = jnp.tile(valid, (kc,))
+    sam = samples.reshape(chunks, kc * B, T)
+    adv = advantage.reshape(chunks, kc * B)
+
+    def sums_fn(p, tokens, a):
+        return _rl_loss_sums(model, p, feats_f, masks_f, tokens, a, valid_f)
+
+    return accumulate_chunk_grads(sums_fn, params, (sam, adv), vary_axis)
+
+
+def make_rl_update(model, chunks: int = 1) -> Callable:
+    """Jitted: (state, feats, masks, samples [K,B,T], adv [K,B]) -> (state, metrics).
+
+    ``chunks > 1`` accumulates gradients over slices of the rollout axis
+    (same total gradient, K/chunks of the activation memory — see
+    :func:`_chunked_loss_grads`).
+    """
 
     @jax.jit
     def update(state: TrainState, feats, masks, samples, advantage, valid):
-        K, B, T = samples.shape
-        feats_f, masks_f = _tile_feats(feats, masks, K)
-        tokens = samples.reshape(K * B, T)
-        adv = advantage.reshape(K * B)
-        valid_f = jnp.tile(valid, (K,))
-
-        def loss_fn(p):
-            num, den = _rl_loss_sums(
-                model, p, feats_f, masks_f, tokens, adv, valid_f
+        if chunks > 1:
+            num, den, g_sum = _chunked_loss_grads(
+                model, state.params, feats, masks, samples, advantage, valid,
+                chunks,
             )
-            return num / jnp.maximum(den, 1.0)
+            den = jnp.maximum(den, 1.0)
+            loss = num / den
+            grads = jax.tree.map(lambda g: g / den, g_sum)
+        else:
+            K, B, T = samples.shape
+            feats_f, masks_f = _tile_feats(feats, masks, K)
+            tokens = samples.reshape(K * B, T)
+            adv = advantage.reshape(K * B)
+            valid_f = jnp.tile(valid, (K,))
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            def loss_fn(p):
+                num, den = _rl_loss_sums(
+                    model, p, feats_f, masks_f, tokens, adv, valid_f
+                )
+                return num / jnp.maximum(den, 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
         state = state.apply_gradients(grads)
         return state, {"rl_loss": loss, "grad_norm": gnorm}
@@ -141,22 +213,33 @@ def make_rl_update(model) -> Callable:
     return update
 
 
-def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data") -> Callable:
-    """shard_map variant: batch axis sharded, exact global normalization."""
+def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
+                            chunks: int = 1) -> Callable:
+    """shard_map variant: batch axis sharded, exact global normalization.
+    ``chunks`` accumulates over the rollout axis exactly like
+    :func:`make_rl_update`."""
 
     def device_update(state, feats, masks, samples, advantage, valid):
-        K, Bl, T = samples.shape
-        feats_f, masks_f = _tile_feats(feats, masks, K)
-        tokens = samples.reshape(K * Bl, T)
-        adv = advantage.reshape(K * Bl)
-        valid_f = jnp.tile(valid, (K,))
+        if chunks > 1:
+            num, den, grads_num = _chunked_loss_grads(
+                model, state.params, feats, masks, samples, advantage, valid,
+                chunks, vary_axis=axis,
+            )
+        else:
+            K, Bl, T = samples.shape
+            feats_f, masks_f = _tile_feats(feats, masks, K)
+            tokens = samples.reshape(K * Bl, T)
+            adv = advantage.reshape(K * Bl)
+            valid_f = jnp.tile(valid, (K,))
 
-        def local_num(p):
-            return _rl_loss_sums(model, p, feats_f, masks_f, tokens, adv, valid_f)
+            def local_num(p):
+                return _rl_loss_sums(
+                    model, p, feats_f, masks_f, tokens, adv, valid_f
+                )
 
-        (num, den), grads_num = jax.value_and_grad(local_num, has_aux=True)(
-            state.params
-        )
+            (num, den), grads_num = jax.value_and_grad(
+                local_num, has_aux=True
+            )(state.params)
         den_total = jax.lax.psum(den, axis)
         loss = jax.lax.psum(num, axis) / jnp.maximum(den_total, 1.0)
         grads = jax.tree.map(
@@ -214,17 +297,19 @@ class SCSTTrainer:
                 spm, mesh, cfg.num_rollouts, cfg.temperature, max_len,
                 data_axis="data",
             )
-            self.update = make_sp_rl_update(spm, mesh)
+            self.update = make_sp_rl_update(spm, mesh, chunks=cfg.update_chunks)
         elif mesh is not None:
             self.decode = make_parallel_rl_decode(
                 model, mesh, cfg.num_rollouts, cfg.temperature, max_len
             )
-            self.update = make_parallel_rl_update(model, mesh)
+            self.update = make_parallel_rl_update(
+                model, mesh, chunks=cfg.update_chunks
+            )
         else:
             self.decode = make_rl_decode(
                 model, cfg.num_rollouts, cfg.temperature, max_len
             )
-            self.update = make_rl_update(model)
+            self.update = make_rl_update(model, chunks=cfg.update_chunks)
 
     # ---- reward / advantage (host) ------------------------------------------
 

@@ -210,22 +210,23 @@ def test_sp_rl_update_matches_single_device(setup):
     f, m = _place(mesh, cfg, feats, masks, "data")
     bshard = NamedSharding(mesh, P("data"))
     kb_shard = NamedSharding(mesh, P(None, "data"))
-    p_state, p_m = make_sp_rl_update(spm, mesh)(
-        state, f, m,
-        jax.device_put(samples, kb_shard),
-        jax.device_put(advantage, kb_shard),
-        jax.device_put(valid, bshard),
-    )
-    np.testing.assert_allclose(
-        float(s_m["rl_loss"]), float(p_m["rl_loss"]), rtol=1e-5
-    )
-    for a, b in zip(
-        jax.tree_util.tree_leaves(s_state.params),
-        jax.tree_util.tree_leaves(p_state.params),
-    ):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+    for chunks in (1, 3):  # fused + rollout-axis gradient accumulation
+        p_state, p_m = make_sp_rl_update(spm, mesh, chunks=chunks)(
+            state, f, m,
+            jax.device_put(samples, kb_shard),
+            jax.device_put(advantage, kb_shard),
+            jax.device_put(valid, bshard),
         )
+        np.testing.assert_allclose(
+            float(s_m["rl_loss"]), float(p_m["rl_loss"]), rtol=1e-5
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s_state.params),
+            jax.tree_util.tree_leaves(p_state.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
 
 
 def test_sp_handles_very_long_frame_axis(setup):
