@@ -47,7 +47,12 @@ def _data_to_keys(loaded, template):
 
 def save_state(ckpt_dir: str, name: str, state: TrainState,
                infos: dict[str, Any] | None = None) -> str:
-    """Atomically write state+infos under ``ckpt_dir/name``; returns the path."""
+    """Atomically write state+infos under ``ckpt_dir/name``; returns the path.
+
+    CONTRACT: one writer per ``ckpt_dir`` at a time — crash-atomic (a kill
+    mid-save leaves only the stale ``.tmp``, reclaimed by the next save),
+    not concurrency-atomic (directory swap is rmtree+rename). Multi-host
+    runs satisfy this via the Trainer's process-0 checkpoint gate."""
     final = os.path.join(ckpt_dir, name)
     tmp = final + ".tmp"
     if os.path.exists(tmp):

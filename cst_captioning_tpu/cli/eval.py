@@ -29,6 +29,9 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--results-json", default="results.json")
     args = p.parse_args(argv)
 
+    from cst_captioning_tpu.train import multihost
+
+    multihost.initialize()  # no-op unless the JAX_* cluster env vars are set
     cfg = load_config(args)
     split = args.split or cfg.eval.split
     ds = open_dataset(args, cfg, split)
@@ -53,12 +56,16 @@ def main(argv: list[str] | None = None) -> None:
                          seq_devices=cfg.mesh.seq_devices)
         params = replicate(mesh, params)
 
+    # multi-host: every process computes the full result (the caption gather
+    # is collective), but only process 0 writes the shared results file
+    results_json = args.results_json if jax.process_index() == 0 else ""
     result = evaluate_split(
         model, params, ds, cfg.eval,
-        batch_size=cfg.data.batch_size, results_json=args.results_json,
+        batch_size=cfg.data.batch_size, results_json=results_json,
         mesh=mesh,
     )
-    print(json.dumps(result["metrics"], indent=2, default=float))
+    if jax.process_index() == 0:
+        print(json.dumps(result["metrics"], indent=2, default=float))
 
 
 if __name__ == "__main__":

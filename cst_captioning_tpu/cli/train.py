@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 
 from cst_captioning_tpu.cli.common import add_common_args, load_config, open_dataset
+from cst_captioning_tpu.train import multihost
 from cst_captioning_tpu.train.trainer import Trainer
 
 
@@ -23,6 +24,8 @@ def main(argv: list[str] | None = None) -> None:
     add_common_args(p)
     p.add_argument("--skip-xe", action="store_true", help="run only the RL phase")
     args = p.parse_args(argv)
+    # multi-host: no-op unless JAX_COORDINATOR_ADDRESS etc. are set
+    multihost.initialize()
 
     cfg = load_config(args)
     train_ds = open_dataset(args, cfg, "train")

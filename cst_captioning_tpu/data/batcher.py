@@ -67,6 +67,7 @@ class Batcher:
         seq_per_vid: int = 1,
         seed: int = 0,
         drop_last: bool = False,
+        host_shard: tuple[int, int] = (0, 1),
     ):
         if mode not in ("caption", "video"):
             raise ValueError(f"unknown mode {mode!r}")
@@ -78,6 +79,21 @@ class Batcher:
         self.seed = seed
         self.epoch_index = 0  # set from the checkpoint epoch on resume
         self.drop_last = drop_last
+        # multi-host data feeding (train/multihost.py): every process forms
+        # the SAME global batch order — the shuffle is keyed by (seed,
+        # epoch_index), no communication needed — and collates only its own
+        # contiguous slice of each batch. batch_size stays GLOBAL; collated
+        # arrays are [batch_size // count] rows.
+        idx, count = host_shard
+        if batch_size % count:
+            raise ValueError(
+                f"global batch_size {batch_size} must be divisible by "
+                f"host_shard count {count}"
+            )
+        if not 0 <= idx < count:
+            raise ValueError(f"host_shard index {idx} not in [0, {count})")
+        self.host_shard = (idx, count)
+        self.local_batch_size = batch_size // count
 
     def _items(self, rng: np.random.Generator | None) -> list[tuple[int, int]]:
         """List of (record_idx, caption_idx) rows for one epoch."""
@@ -106,6 +122,8 @@ class Batcher:
             self.epoch_index += 1
         items = self._items(rng)
         bs = self.batch_size
+        idx, count = self.host_shard
+        lb = self.local_batch_size
         n = len(items)
         for start in range(0, n, bs):
             chunk = items[start : start + bs]
@@ -117,10 +135,14 @@ class Batcher:
                 chunk = chunk + pad
             else:
                 valid = np.ones((bs,), dtype=bool)
+            if count > 1:
+                # this process's contiguous slice of the global batch
+                chunk = chunk[idx * lb : (idx + 1) * lb]
+                valid = valid[idx * lb : (idx + 1) * lb]
             yield self._collate(chunk, valid)
 
     def _collate(self, items: list[tuple[int, int]], valid: np.ndarray) -> Batch:
-        bs, T = self.batch_size, self.max_len
+        bs, T = self.local_batch_size, self.max_len
         names = list(self.ds.stores)
         feats = {
             n: np.zeros((bs, self.ds.max_frames, self.ds.stores[n].dim), np.float32)

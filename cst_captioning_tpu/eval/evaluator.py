@@ -24,6 +24,7 @@ from cst_captioning_tpu.data.dataset import CaptionDataset
 from cst_captioning_tpu.decoding import beam_search, greedy_decode
 from cst_captioning_tpu.metrics.scorer import CaptionScorer
 from cst_captioning_tpu.parallel import sp_batch_specs, sp_model
+from cst_captioning_tpu.train import multihost
 from cst_captioning_tpu.train.mesh import batch_sharding
 from cst_captioning_tpu.train.steps import batch_arrays
 
@@ -118,15 +119,24 @@ class Evaluator:
         self._decode = jax.jit(decode)
 
     def generate(self, params) -> dict[str, str]:
-        """Decode every video of the split -> {video_id: caption string}."""
+        """Decode every video of the split -> {video_id: caption string}.
+
+        Multi-host: every process iterates the same (unsharded) batches,
+        placement extracts each host's shards from the replicated input, and
+        the decoded tokens are allgathered so every process returns the full
+        caption dict (train/multihost.py)."""
         out: dict[str, str] = {}
         for batch in self.batcher.epoch(shuffle=False):
-            feats, masks, *_ = batch_arrays(batch)
             if self._fm_shardings is not None:
-                feats, masks = jax.device_put(
-                    (feats, masks), self._fm_shardings
+                # numpy straight into the target sharding (single transfer)
+                feats, masks = multihost.put_full_global(
+                    self._fm_shardings, (batch.feats, batch.feat_masks)
                 )
-            tokens = np.asarray(self._decode(params, feats, masks))
+            else:
+                feats, masks, *_ = batch_arrays(batch)
+            tokens = multihost.allgather_to_host(
+                self._decode(params, feats, masks)
+            )
             for i, ok in enumerate(batch.valid):
                 if ok:
                     out[batch.video_ids[i]] = self.ds.vocab.decode(tokens[i])

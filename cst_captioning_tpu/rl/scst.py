@@ -344,15 +344,31 @@ class SCSTTrainer:
         return advantage, metrics
 
     def _finish(self, state, greedy, samples, feats, masks, video_ids, valid_np):
-        """Score a decoded batch and apply the REINFORCE update."""
-        samples_np = np.asarray(samples)                     # [K, B, T]
+        """Score a decoded batch and apply the REINFORCE update.
+
+        Multi-host: ``video_ids``/``valid_np`` are THIS process's rows (the
+        host-sharded Batcher), so the decoded tokens come back per-host
+        (``to_host_local``), the reward is computed on local rows only, and
+        the local advantage is re-assembled into a global sharded array for
+        the update — host scoring never crosses DCN (SURVEY.md §5).
+        """
+        from cst_captioning_tpu.train import multihost
+
+        samples_np = multihost.to_host_local(          # [K, B_local, T]
+            samples, self.mesh, P(None, "data")
+        ) if self.mesh is not None else np.asarray(samples)
+        greedy_np = multihost.to_host_local(
+            greedy, self.mesh, P("data")
+        ) if self.mesh is not None else np.asarray(greedy)
         advantage, host_metrics = self._advantage(
-            greedy, samples_np, video_ids, valid_np
+            greedy_np, samples_np, video_ids, valid_np
         )
-        state, metrics = self.update(
-            state, feats, masks, samples,
-            jnp.asarray(advantage, jnp.float32), jnp.asarray(valid_np),
-        )
+        adv = jnp.asarray(advantage, jnp.float32)
+        valid = jnp.asarray(valid_np)
+        if self.mesh is not None:
+            adv = multihost.from_host_local(adv, self.mesh, P(None, "data"))
+            valid = multihost.from_host_local(valid, self.mesh, P("data"))
+        state, metrics = self.update(state, feats, masks, samples, adv, valid)
         metrics = dict(metrics)
         metrics.update(host_metrics)
         return state, metrics
@@ -369,7 +385,9 @@ class SCSTTrainer:
     def train_step(self, state: TrainState, feats, masks, video_ids, rng,
                    valid=None):
         greedy, samples = self.decode(state.params, feats, masks, rng)
-        valid_np = self._valid_np(valid, samples.shape[1])
+        # sized from the LOCAL row count (== global single-host; under
+        # multi-host, samples is a global array but the reward rows are ours)
+        valid_np = self._valid_np(valid, len(video_ids))
         return self._finish(
             state, greedy, samples, feats, masks, video_ids, valid_np
         )
@@ -402,7 +420,7 @@ class SCSTTrainer:
                 if on_step is not None:
                     on_step(m)
             greedy, samples = decoded
-            valid_np = self._valid_np(valid, samples.shape[1])
+            valid_np = self._valid_np(valid, len(video_ids))
             pending = (greedy, samples, feats, masks, video_ids, valid_np)
         if pending is not None:
             state, m = self._finish(state, *pending)

@@ -50,5 +50,11 @@ def shard_batch(mesh: Mesh, batch, axis: str = "data"):
 
 
 def replicate(mesh: Mesh, tree):
-    """Replicate a pytree (params / train state) on every mesh device."""
-    return jax.device_put(tree, replicated_sharding(mesh))
+    """Replicate a pytree (params / train state) on every mesh device.
+
+    Multi-host: every process already holds an identical host copy (same
+    init seed / same restored checkpoint), so the global replicated arrays
+    assemble from the local ones without communication (multihost.py)."""
+    from cst_captioning_tpu.train import multihost
+
+    return multihost.put_full_global(replicated_sharding(mesh), tree)

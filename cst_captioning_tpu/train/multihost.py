@@ -1,0 +1,165 @@
+"""Multi-host distributed support: DP/SP past one host, over ICI + DCN.
+
+The reference scaled with single-node ``torch.nn.DataParallel`` (NCCL
+underneath — SURVEY.md §2 parallelism inventory, §5 dist-comm row). This
+module is the multi-HOST extension the reference never had: each process
+(host) runs the same program, ``jax.distributed.initialize`` forms the
+global device set, and the jitted shard_map steps are IDENTICAL to the
+single-host ones — XLA routes the gradient psums over ICI within a host and
+DCN across hosts, exactly the mesh-axis layering SURVEY.md §5 reserved.
+
+The host-side contract (the part XLA cannot do for us):
+
+- **Input**: every process feeds only its own rows.
+  :class:`~cst_captioning_tpu.data.batcher.Batcher` with
+  ``host_shard=(process_index, process_count)`` deterministically slices the
+  same global batch order (the shuffle is keyed by (seed, epoch), so all
+  hosts agree without communicating); :func:`put_global` assembles the
+  per-process rows into one globally-sharded array.
+- **Output**: device results that the host must read (decoded tokens for
+  the RL reward or eval) come back via :func:`to_host_local` (this host's
+  rows only — the per-host reward path) or :func:`allgather_to_host`
+  (replicated everywhere — eval needs every caption).
+
+Single-process behavior is the identity: every helper degrades to the plain
+device_put / np.asarray path, so the Trainer wiring is exercised by the
+regular test suite and the 2-process parity test
+(tests/test_multihost.py) pins multi == single numerically.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """``jax.distributed.initialize`` wrapper.
+
+    With no arguments, initializes only when the standard env vars are set
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``,
+    or a TPU-pod environment where JAX auto-detects everything); a plain
+    single-host run is untouched. Safe to call twice (second call no-ops).
+    """
+    # NOTE: must not touch jax.process_count()/jax.devices() here — any
+    # backend-initializing call before jax.distributed.initialize is an error
+    if jax.distributed.is_initialized():
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_n = os.environ.get("JAX_NUM_PROCESSES")
+    env_i = os.environ.get("JAX_PROCESS_ID")
+    if num_processes is None and env_n is not None:
+        num_processes = int(env_n)
+    if process_id is None and env_i is not None:
+        process_id = int(env_i)
+    if coordinator_address is None and num_processes is None:
+        return  # single-host run
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def host_shard() -> tuple[int, int]:
+    """(process_index, process_count) — the Batcher ``host_shard`` argument."""
+    return jax.process_index(), jax.process_count()
+
+
+def put_global(shardings, local_tree):
+    """Per-process rows -> globally sharded arrays.
+
+    ``shardings`` is a NamedSharding pytree (a tree prefix of
+    ``local_tree``); each process passes ONLY its own rows and the result is
+    the global array every jitted step sees. Single-process this is exactly
+    ``jax.device_put``.
+    """
+    if not is_multiprocess():
+        return jax.device_put(local_tree, shardings)
+    return _map_prefix(
+        lambda s, x: jax.make_array_from_process_local_data(s, np.asarray(x)),
+        shardings, local_tree,
+    )
+
+
+def put_full_global(shardings, full_tree):
+    """Every-process-identical host arrays -> globally sharded arrays.
+
+    The eval path: each process iterates the SAME (unsharded) batches, so
+    the local data already has the global shape; passing ``global_shape``
+    tells jax the input is fully replicated and only this process's shards
+    should be extracted. Single-process this is exactly ``jax.device_put``.
+    """
+    if not is_multiprocess():
+        return jax.device_put(full_tree, shardings)
+
+    def put(s, x):
+        # typed PRNG keys (TrainState.rng) can't pass through the raw-array
+        # assembly; round-trip via their uint32 key data
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+            x.dtype, jax.dtypes.prng_key
+        ):
+            data = np.asarray(jax.random.key_data(x))
+            g = jax.make_array_from_process_local_data(
+                s, data, global_shape=data.shape
+            )
+            return jax.random.wrap_key_data(g)
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            s, x, global_shape=x.shape
+        )
+
+    return _map_prefix(put, shardings, full_tree)
+
+
+def _map_prefix(fn, shardings, tree):
+    """Apply ``fn(sharding, leaf)`` with device_put's tree-prefix broadcast:
+    a single sharding applies to every leaf below it."""
+
+    def rec(s, x):
+        if isinstance(s, jax.sharding.Sharding):
+            return jax.tree.map(lambda leaf: fn(s, leaf), x)
+        if isinstance(x, dict):
+            return {k: rec(s[k], x[k]) for k in x}
+        return type(x)(rec(si, xi) for si, xi in zip(s, x))
+
+    return rec(shardings, tree)
+
+
+def to_host_local(arr, mesh: Mesh, spec: P) -> np.ndarray:
+    """Sharded global array -> THIS process's rows as numpy (per-host reward
+    path). Single-process: plain ``np.asarray``."""
+    if not is_multiprocess():
+        return np.asarray(arr)
+    local = multihost_utils.global_array_to_host_local_array(arr, mesh, spec)
+    return np.asarray(local)
+
+
+def from_host_local(arr, mesh: Mesh, spec: P):
+    """THIS process's rows -> sharded global array (advantage upload).
+    Single-process: the identity."""
+    if not is_multiprocess():
+        return arr
+    return multihost_utils.host_local_array_to_global_array(
+        np.asarray(arr), mesh, spec
+    )
+
+
+def allgather_to_host(arr) -> np.ndarray:
+    """Sharded global array -> full array on EVERY process (eval gather).
+    Single-process: plain ``np.asarray``."""
+    if not is_multiprocess():
+        return np.asarray(arr)
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

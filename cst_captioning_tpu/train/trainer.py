@@ -31,6 +31,7 @@ from cst_captioning_tpu.parallel import (
     sp_model,
 )
 from cst_captioning_tpu.rl import RewardComputer, SCSTTrainer
+from cst_captioning_tpu.train import multihost
 from cst_captioning_tpu.train.mesh import batch_sharding, make_mesh, replicate
 from cst_captioning_tpu.train.schedule import make_optimizer
 from cst_captioning_tpu.train.state import TrainState, create_train_state
@@ -110,6 +111,9 @@ class Trainer:
                     f"divisible by mesh.seq_devices {self.mesh.shape['seq']}"
                 )
 
+        # multi-host: each process collates only its slice of every global
+        # batch (identical global order — the shuffle is epoch-keyed);
+        # put_global below assembles the slices into globally-sharded arrays
         self.batcher = Batcher(
             train_ds,
             batch_size=cfg.data.batch_size,
@@ -117,6 +121,7 @@ class Trainer:
             mode="caption",
             seq_per_vid=cfg.data.seq_per_vid,
             seed=cfg.data.shuffle_seed,
+            host_shard=multihost.host_shard() if self.use_mesh else (0, 1),
         )
         self.steps_per_epoch = self.batcher.num_batches()
         tx = make_optimizer(cfg.train, self.steps_per_epoch)
@@ -217,28 +222,45 @@ class Trainer:
         return batch_sharding(self.mesh)
 
     def _device_batches(self, batcher: Batcher):
+        shardings = self._batch_sharding()
+
+        def transform(b):
+            if shardings is None:
+                # valid rides along so wrap-padding rows get zero weight
+                return batch_arrays(b) + (
+                    jax.numpy.asarray(np.asarray(b.valid, np.float32)),
+                )
+            # keep the Batch's numpy arrays as-is: put_global transfers them
+            # host->device exactly once, straight into the target sharding
+            arrays = (
+                b.feats, b.feat_masks, b.labels, b.mask, b.weights,
+                np.asarray(b.valid, np.float32),
+            )
+            return multihost.put_global(shardings, arrays)
+
         yield from prefetch_to_device(
             batcher.epoch(),
             size=self.cfg.data.prefetch,
-            sharding=self._batch_sharding(),
-            # valid rides along so wrap-padded duplicate rows get zero weight
-            transform=lambda b: batch_arrays(b)
-            + (jax.numpy.asarray(b.valid, jax.numpy.float32),),
+            transform=transform,
+            place=shardings is None,
         )
 
     def _rl_device_batches(self, batcher: Batcher):
         """Prefetched RL batches: arrays staged to device (sharded when a mesh
-        is in play), video ids + valid mask staying host-side for the reward."""
+        is in play), video ids + valid mask staying host-side (this process's
+        rows) for the reward."""
         sharding = self._batch_sharding()
         if sharding is not None and self.sp:
             sharding = (sharding[0], sharding[1])  # (feats, masks) only
 
         def transform(b):
-            feats, masks, *_ = batch_arrays(b)
             if sharding is not None:
-                feats, masks = jax.device_put((feats, masks), sharding)
+                # numpy straight into the target sharding (single transfer)
+                feats, masks = multihost.put_global(
+                    sharding, (b.feats, b.feat_masks)
+                )
             else:
-                feats, masks = jax.device_put((feats, masks))
+                feats, masks = jax.device_put((b.feats, b.feat_masks))
             return (feats, masks, b.video_ids, b.valid)
 
         yield from prefetch_to_device(
@@ -354,6 +376,7 @@ class Trainer:
             max_len=cfg.model.max_len,
             mode="video",
             seed=cfg.data.shuffle_seed,
+            host_shard=multihost.host_shard() if self.use_mesh else (0, 1),
         )
         # keyed off the global epoch so a resumed RL phase replays the same
         # per-epoch batch order as an uninterrupted run
@@ -422,9 +445,14 @@ class Trainer:
         if self.validator is not None and (
             self.epoch % self.cfg.train.eval_every_epochs == 0
         ):
+            # multi-host: validation runs on EVERY process (the sharded
+            # decode is a collective program), but only process 0 writes the
+            # checkpoint on the shared filesystem below
             result = self.validator.evaluate(self.state.params)
             value = result["metrics"].get("CIDEr-D")
             self.log.log("validate", epoch=self.epoch, cider_d=value)
+        if jax.process_index() != 0:
+            return value
         is_best = self.ckpt.save(
             jax.device_get(self.state),
             value,

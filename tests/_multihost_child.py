@@ -1,0 +1,47 @@
+"""Child process for tests/test_multihost.py — NOT a pytest module.
+
+Runs one member of a 2-process jax.distributed cluster (4 fake CPU devices
+each = 8 global), trains XE + RL through the Trainer with host-sharded data
+feeding, evaluates, and (process 0 only) dumps parity artifacts to json.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    data_dir = sys.argv[4]
+    out_json = sys.argv[5]
+    tmp = sys.argv[6]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from cst_captioning_tpu.train import multihost
+
+    multihost.initialize(f"127.0.0.1:{port}", nproc, pid)
+    assert jax.process_count() == nproc
+    assert len(jax.devices()) == 4 * nproc
+
+    import numpy as np
+
+    from tests.test_multihost import build_cfg, run_training
+
+    result = run_training(
+        data_dir, ckpt_dir=os.path.join(tmp, f"ckpt{pid}")
+    )
+    if pid == 0:
+        with open(out_json, "w") as f:
+            json.dump(result, f)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()

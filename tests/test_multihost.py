@@ -1,0 +1,210 @@
+"""Multi-host (DCN) support: host-sharded feeding + 2-process parity.
+
+SURVEY.md §5 dist-comm row reserved a multi-host extension of the data
+parallelism; train/multihost.py implements it. These tests pin:
+
+1. Batcher ``host_shard`` slicing: the union of every host's local batches
+   is exactly the unsharded global batch stream (same order, same rows).
+2. The single-process degradations of every multihost helper are the plain
+   device_put / np.asarray paths.
+3. A REAL 2-process jax.distributed cluster (Gloo collectives on CPU,
+   4 fake devices per process = 8 global) trains XE + RL through the
+   Trainer and evaluates, matching the single-process 8-device run:
+   bit-comparable params and identical captions.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- shared recipe (also imported by tests/_multihost_child.py) -------------
+
+
+def build_cfg(vocab_size: int, ckpt_dir: str):
+    import dataclasses
+
+    from cst_captioning_tpu.config.config import (
+        DataConfig, EvalConfig, ExperimentConfig, ModelConfig, RLConfig,
+        TrainConfig,
+    )
+
+    return ExperimentConfig(
+        name="mh",
+        model=ModelConfig(
+            vocab_size=vocab_size,
+            modalities=(("resnet", 12),),
+            d_embed=16, d_hidden=16, d_att=8,
+            encoder="temporal_attention", dropout=0.0,
+            max_len=8, max_frames=4, dtype="float32",
+        ),
+        data=DataConfig(batch_size=8, seq_per_vid=2),
+        train=TrainConfig(
+            lr=5e-3, epochs=1, grad_clip=5.0, ckpt_dir=ckpt_dir,
+            eval_every_epochs=100, seed=0,
+        ),
+        rl=RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                    lr=1e-3, epochs=1),
+        eval=EvalConfig(beam_size=2, max_len=8),
+    )
+
+
+def run_training(data_dir: str, ckpt_dir: str) -> dict:
+    """Train 1 XE + 1 RL epoch and beam-eval the test split; return parity
+    artifacts (per-leaf param sums + captions). Works single- OR
+    multi-process: the Trainer/Evaluator multihost wiring adapts."""
+    import jax
+
+    from cst_captioning_tpu.config.config import EvalConfig
+    from cst_captioning_tpu.data import CaptionDataset
+    from cst_captioning_tpu.eval.evaluator import Evaluator
+    from cst_captioning_tpu.train.trainer import Trainer
+
+    paths = {
+        "info_json": os.path.join(data_dir, "info.json"),
+        "resnet": os.path.join(data_dir, "resnet.h5"),
+    }
+    train_ds = CaptionDataset(paths["info_json"], {"resnet": paths["resnet"]},
+                              "train", 4)
+    test_ds = CaptionDataset(paths["info_json"], {"resnet": paths["resnet"]},
+                             "test", 4)
+    cfg = build_cfg(len(train_ds.vocab), ckpt_dir)
+    tr = Trainer(cfg, train_ds, None, use_mesh=True)
+    tr.train_xe()
+    tr.train_rl()
+    ev = Evaluator(tr.model, test_ds, EvalConfig(beam_size=2, max_len=8),
+                   batch_size=8, mesh=tr.mesh)
+    captions = ev.generate(tr.state.params)
+    leaf_sums = [
+        float(np.asarray(x, np.float64).sum())
+        for x in jax.tree_util.tree_leaves(jax.device_get(tr.state.params))
+    ]
+    train_ds.close()
+    test_ds.close()
+    return {"leaf_sums": leaf_sums, "captions": captions}
+
+
+@pytest.fixture(scope="module")
+def synth(tmp_path_factory):
+    from cst_captioning_tpu.data import make_synthetic_dataset
+
+    out = tmp_path_factory.mktemp("mhsynth")
+    paths = make_synthetic_dataset(
+        str(out), num_videos=16, num_topics=3, vocab_words=20,
+        modalities={"resnet": 12}, max_frames=4, seed=9,
+    )
+    return os.path.dirname(paths["info_json"])
+
+
+# ---- 1. host-sharded batcher ------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["caption", "video"])
+def test_host_shard_slices_reassemble_global_stream(synth, mode):
+    from cst_captioning_tpu.data import Batcher, CaptionDataset
+
+    ds = CaptionDataset(os.path.join(synth, "info.json"),
+                        {"resnet": os.path.join(synth, "resnet.h5")},
+                        "train", 4)
+    kw = dict(batch_size=6, max_len=8, mode=mode, seq_per_vid=2, seed=3)
+    whole = Batcher(ds, **kw)
+    parts = [Batcher(ds, **kw, host_shard=(i, 2)) for i in range(2)]
+    for b_all, b0, b1 in zip(whole.epoch(), parts[0].epoch(), parts[1].epoch()):
+        assert b0.labels.shape[0] == 3 and b1.labels.shape[0] == 3
+        assert b_all.video_ids == b0.video_ids + b1.video_ids
+        np.testing.assert_array_equal(
+            b_all.labels, np.concatenate([b0.labels, b1.labels])
+        )
+        np.testing.assert_array_equal(
+            b_all.valid, np.concatenate([b0.valid, b1.valid])
+        )
+        np.testing.assert_array_equal(
+            b_all.feats["resnet"],
+            np.concatenate([b0.feats["resnet"], b1.feats["resnet"]]),
+        )
+    ds.close()
+
+
+def test_host_shard_validation(synth):
+    from cst_captioning_tpu.data import Batcher, CaptionDataset
+
+    ds = CaptionDataset(os.path.join(synth, "info.json"),
+                        {"resnet": os.path.join(synth, "resnet.h5")},
+                        "train", 4)
+    with pytest.raises(ValueError, match="divisible"):
+        Batcher(ds, batch_size=5, max_len=8, host_shard=(0, 2))
+    with pytest.raises(ValueError, match="index"):
+        Batcher(ds, batch_size=4, max_len=8, host_shard=(2, 2))
+    ds.close()
+
+
+# ---- 2. single-process helper degradations ---------------------------------
+
+
+def test_helpers_single_process_identity():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from cst_captioning_tpu.train import multihost
+    from cst_captioning_tpu.train.mesh import batch_sharding, make_mesh
+
+    assert not multihost.is_multiprocess()
+    assert multihost.host_shard() == (0, 1)
+    mesh = make_mesh()
+    s = batch_sharding(mesh)
+    tree = ({"a": np.ones((8, 3), np.float32)}, np.arange(8, dtype=np.int32))
+    placed = multihost.put_global((s, s), tree)
+    np.testing.assert_array_equal(np.asarray(placed[0]["a"]), tree[0]["a"])
+    placed2 = multihost.put_full_global(s, np.ones((8, 2), np.float32))
+    assert placed2.sharding == s
+    arr = placed[1]
+    np.testing.assert_array_equal(
+        multihost.to_host_local(arr, mesh, P("data")), tree[1]
+    )
+    assert multihost.from_host_local(arr, mesh, P("data")) is arr
+    np.testing.assert_array_equal(multihost.allgather_to_host(arr), tree[1])
+
+
+# ---- 3. the real thing: 2-process cluster == single-process ----------------
+
+
+def test_two_process_cluster_matches_single_process(synth, tmp_path):
+    """Full XE + RL + beam-eval parity: a 2-process jax.distributed cluster
+    (Gloo over localhost, 8 global fake devices) produces the same params
+    and the exact same captions as the single-process 8-device run."""
+    single = run_training(synth, str(tmp_path / "ckpt_single"))
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    out_json = str(tmp_path / "mh.json")
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "_multihost_child.py"),
+             str(i), "2", str(port), synth, out_json, str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"process {i} failed:\n{err[-4000:]}"
+
+    multi = json.load(open(out_json))
+    assert multi["captions"] == single["captions"]
+    np.testing.assert_allclose(
+        multi["leaf_sums"], single["leaf_sums"], rtol=1e-4, atol=1e-5
+    )
