@@ -26,6 +26,13 @@ def main(argv: list[str] | None = None) -> None:
     args = p.parse_args(argv)
     # multi-host: no-op unless JAX_COORDINATOR_ADDRESS etc. are set
     multihost.initialize()
+    if args.log_jsonl and multihost.is_multiprocess():
+        import jax
+
+        if jax.process_index() != 0:
+            # one JSONL per process: append-interleaving on a shared path
+            # would corrupt per-epoch analysis
+            args.log_jsonl = f"{args.log_jsonl}.proc{jax.process_index()}"
 
     cfg = load_config(args)
     train_ds = open_dataset(args, cfg, "train")

@@ -56,6 +56,11 @@ class ModelConfig:
     # (pmax/psum over ICI), so videos longer than one chip's HBM still train
     # and decode. "" = single-device frame axis (the default).
     seq_axis: str = ""
+    # temporal-attention context implementation: "xla" (the fused composite
+    # XLA compiles, default) or "pallas" (ops/attention_pallas.py — blockwise
+    # online softmax over the frame axis keeping the [B, M, d_att] tanh
+    # intermediate in VMEM; parity-tested, for long-context frame counts)
+    attention_impl: str = "xla"
 
     def __post_init__(self):
         if isinstance(self.modalities, Mapping):
@@ -66,6 +71,11 @@ class ModelConfig:
             )
         if self.encoder not in ("meanpool", "temporal_attention"):
             raise ValueError(f"unknown encoder: {self.encoder!r}")
+        if self.attention_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown attention_impl: {self.attention_impl!r} "
+                "(expected 'xla' or 'pallas')"
+            )
 
     @property
     def modality_names(self) -> tuple[str, ...]:

@@ -33,7 +33,7 @@ class DecoderCell(nn.Module):
         )
         self.attention = AdditiveAttention(
             d_att=cfg.d_att, dtype=dtype, param_dtype=pdtype, name="attention",
-            seq_axis=cfg.seq_axis,
+            seq_axis=cfg.seq_axis, impl=cfg.attention_impl,
         )
         self.lstm = [
             nn.OptimizedLSTMCell(

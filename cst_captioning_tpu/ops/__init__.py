@@ -1,0 +1,12 @@
+"""Hand-written TPU kernels (Pallas) for the hot ops.
+
+XLA's fusions cover this model well (SURVEY.md §2: "the TPU build's native
+layer is XLA itself plus optional Pallas kernels"); this package holds the
+optional kernels where explicit VMEM blocking beats the default — currently
+the long-context additive-attention context (flash-style online softmax over
+the frame axis).
+"""
+
+from cst_captioning_tpu.ops.attention_pallas import fused_additive_attention
+
+__all__ = ["fused_additive_attention"]

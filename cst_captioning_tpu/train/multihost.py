@@ -37,6 +37,15 @@ from jax.experimental import multihost_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+# env markers of cluster schedulers jax.distributed can auto-detect
+_CLUSTER_ENV_HINTS = (
+    "SLURM_NTASKS",
+    "OMPI_COMM_WORLD_SIZE",
+    "TPU_WORKER_HOSTNAMES",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
                process_id: int | None = None) -> None:
@@ -61,7 +70,12 @@ def initialize(coordinator_address: str | None = None,
     if process_id is None and env_i is not None:
         process_id = int(env_i)
     if coordinator_address is None and num_processes is None:
-        return  # single-host run
+        # no explicit cluster spec: hand off to jax's auto-detection ONLY in
+        # environments that advertise one (TPU pod / SLURM / OpenMPI) — a
+        # plain single-host run must not risk a coordinator connect attempt
+        if any(os.environ.get(k) for k in _CLUSTER_ENV_HINTS):
+            jax.distributed.initialize()
+        return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -163,3 +177,14 @@ def allgather_to_host(arr) -> np.ndarray:
     if not is_multiprocess():
         return np.asarray(arr)
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def global_scalar_mean(x: float) -> float:
+    """Mean of a host-side scalar across processes (one tiny collective) —
+    for epoch-level stats whose per-step values are per-host (the RL reward).
+    Single-process: the identity."""
+    if not is_multiprocess():
+        return float(x)
+    return float(
+        np.mean(multihost_utils.process_allgather(np.asarray(x, np.float64)))
+    )

@@ -432,7 +432,9 @@ class Trainer:
             self.log.log(
                 "rl_epoch",
                 epoch=self.epoch,
-                reward=float(np.mean(rewards)),
+                # per-step rewards are scored on this host's rows only; the
+                # epoch stat reduces across processes (equal rows per host)
+                reward=multihost.global_scalar_mean(float(np.mean(rewards))),
                 clips_per_sec=timer.clips_per_sec,
             )
             last_val = self._validate_and_checkpoint()

@@ -1,0 +1,108 @@
+"""Pallas fused additive-attention parity (ops/attention_pallas.py).
+
+Off-TPU these run the kernel in Pallas interpret mode — the same kernel
+code path the TPU compiles through Mosaic (compiled parity at B=64/M=4096
+was verified on a real v5e chip).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config.config import ModelConfig
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.ops.attention_pallas import (
+    _reference,
+    fused_additive_attention,
+)
+
+
+def _inputs(B, M, E, D, seed=0, full_mask_row=None):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(D,)), jnp.float32)
+    memory = jnp.asarray(rng.normal(size=(B, M, E)), jnp.float32)
+    proj = jnp.asarray(rng.normal(size=(B, M, D)), jnp.float32)
+    mask = jnp.asarray(
+        np.arange(M)[None, :] < rng.integers(1, M + 1, size=(B, 1)),
+        jnp.float32,
+    )
+    if full_mask_row is not None:
+        mask = mask.at[full_mask_row].set(0.0)
+    # dataset semantics: padded frames carry zero features
+    memory = memory * mask[:, :, None]
+    return q, v, memory, proj, mask
+
+
+@pytest.mark.parametrize(
+    "B,M", [(5, 200), (8, 128), (3, 7), (16, 300)],
+)
+def test_fused_attention_matches_composite(B, M):
+    """Odd shapes spanning block boundaries, ragged masks, and a
+    fully-masked row (which must yield the same uniform-softmax result,
+    not NaN)."""
+    args = _inputs(B, M, E=24, D=16, full_mask_row=min(2, B - 1))
+    want = _reference(*args)
+    got = fused_additive_attention(*args)
+    assert not np.isnan(np.asarray(got)).any()
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_fused_attention_gradients_match():
+    """The custom-vjp backward (XLA recompute) produces the composite's
+    gradients for every differentiable input."""
+    args = _inputs(6, 150, E=20, D=12, seed=3)
+
+    def loss(f):
+        return lambda *a: jnp.sum(f(*a) ** 2)
+
+    g_ref = jax.grad(loss(_reference), argnums=(0, 1, 2, 3))(*args)
+    g_ker = jax.grad(loss(fused_additive_attention), argnums=(0, 1, 2, 3))(*args)
+    for a, b in zip(g_ref, g_ker):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+        )
+
+
+def test_model_attention_impl_pallas_matches_xla():
+    """ModelConfig.attention_impl='pallas' produces the same teacher-forced
+    logits and greedy captions as the XLA composite, sharing one parameter
+    tree (the score/query/mem_proj params are layout-identical)."""
+    from cst_captioning_tpu.decoding import greedy_decode
+
+    V, B, F, T = 20, 4, 12, 6
+    base = ModelConfig(
+        vocab_size=V, modalities=(("resnet", 10),), d_embed=12, d_hidden=12,
+        d_att=8, encoder="temporal_attention", dropout=0.0, max_len=T,
+        max_frames=F, dtype="float32",
+    )
+    rng = np.random.default_rng(1)
+    feats = {"resnet": jnp.asarray(rng.normal(size=(B, F, 10)), jnp.float32)}
+    masks = {
+        "resnet": jnp.asarray(
+            np.arange(F)[None, :] < rng.integers(3, F + 1, size=(B, 1)),
+            jnp.float32,
+        )
+    }
+    labels = jnp.asarray(rng.integers(4, V, size=(B, T)), jnp.int32)
+
+    m_xla = CaptionModel(base)
+    m_pal = CaptionModel(dataclasses.replace(base, attention_impl="pallas"))
+    params = m_xla.init(jax.random.key(0), feats, masks, labels)
+    # identical parameter trees: the pallas path creates the same params
+    params2 = m_pal.init(jax.random.key(0), feats, masks, labels)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(params2)
+
+    logits_x = m_xla.apply(params, feats, masks, labels)
+    logits_p = m_pal.apply(params, feats, masks, labels)
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_x), rtol=2e-4, atol=2e-5
+    )
+    tok_x, _ = greedy_decode(m_xla, params, feats, masks, max_len=T)
+    tok_p, _ = greedy_decode(m_pal, params, feats, masks, max_len=T)
+    np.testing.assert_array_equal(np.asarray(tok_p), np.asarray(tok_x))
