@@ -49,8 +49,10 @@ TARGET_MULTIPLIER = 3.0
 # B=512 on a 16G v5e chip (B=1024 fused: "Used 18.84G of 15.75G hbm");
 # update_chunks=5 accumulates gradients per rollout, lifting the ceiling.
 # Round-3 sweep on TPU v5e (chunks=5, pipelined): 1024->2074, 1536->2368,
-# 1792->2406, 2048->220 (past the knee: HBM spill collapse). Fused round-2
-# sweep for reference: 64->260, 128->525, 256->865, 512->1341.
+# 1792->2406, 2048->220 (past the knee pre-overlap). With the async
+# device->host token transfer overlap (scst.train_epoch): 1792->~2900-2970,
+# 2048->2813. Fused round-2 sweep for reference: 64->260, 128->525,
+# 256->865, 512->1341.
 BATCH = 1792
 DEFAULT_CHUNKS = 5
 FRAMES = 20

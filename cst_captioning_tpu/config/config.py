@@ -196,6 +196,16 @@ class ExperimentConfig:
     eval: EvalConfig = field(default_factory=EvalConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
 
+    def __post_init__(self):
+        if self.model.attention_impl == "pallas" and self.mesh.seq_devices > 1:
+            # the sequence-parallel path uses the collective softmax and
+            # would silently override the kernel — fail loudly instead
+            raise ValueError(
+                "attention_impl='pallas' is not implemented for the "
+                "sequence-parallel ('seq_devices > 1') path; use one or the "
+                "other"
+            )
+
     # ---- serialization ----------------------------------------------------
 
     def to_dict(self) -> dict[str, Any]:

@@ -414,6 +414,14 @@ class SCSTTrainer:
         for feats, masks, video_ids, valid in batches:
             rng, srng = jax.random.split(rng)
             decoded = self.decode(state.params, feats, masks, srng)
+            for arr in decoded:
+                # start the device->host token transfer NOW, so it overlaps
+                # the previous batch's host scoring and this decode — by the
+                # time _finish reads the tokens they are already on host.
+                # Multi-host global arrays are not fully addressable here;
+                # their reads go through to_host_local instead.
+                if arr.is_fully_addressable:
+                    arr.copy_to_host_async()
             if pending is not None:
                 state, m = self._finish(state, *pending)
                 out.append(m)

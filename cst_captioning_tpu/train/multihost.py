@@ -37,10 +37,13 @@ from jax.experimental import multihost_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-# env markers of cluster schedulers jax.distributed can auto-detect
+# env markers that UNAMBIGUOUSLY mean this process is one worker of a
+# multi-worker accelerator job (multi-host TPU pods). Scheduler vars like
+# SLURM_NTASKS / OMPI_COMM_WORLD_SIZE are deliberately NOT hints: they are
+# also set for single-process runs inside an allocation (tasks reserved for
+# dataloaders etc.), where auto-initialize would hang waiting for peers —
+# SLURM/MPI users pass the explicit JAX_* env vars instead.
 _CLUSTER_ENV_HINTS = (
-    "SLURM_NTASKS",
-    "OMPI_COMM_WORLD_SIZE",
     "TPU_WORKER_HOSTNAMES",
     "MEGASCALE_COORDINATOR_ADDRESS",
 )
