@@ -37,16 +37,21 @@ from jax.experimental import multihost_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-# env markers that UNAMBIGUOUSLY mean this process is one worker of a
-# multi-worker accelerator job (multi-host TPU pods). Scheduler vars like
-# SLURM_NTASKS / OMPI_COMM_WORLD_SIZE are deliberately NOT hints: they are
-# also set for single-process runs inside an allocation (tasks reserved for
-# dataloaders etc.), where auto-initialize would hang waiting for peers —
-# SLURM/MPI users pass the explicit JAX_* env vars instead.
-_CLUSTER_ENV_HINTS = (
-    "TPU_WORKER_HOSTNAMES",
-    "MEGASCALE_COORDINATOR_ADDRESS",
-)
+def _looks_multiworker() -> bool:
+    """True only for env markers that UNAMBIGUOUSLY mean this process is one
+    worker of a multi-worker accelerator job (multi-host TPU pods).
+
+    ``TPU_WORKER_HOSTNAMES`` counts: single-worker setups set it to one host
+    (observed: 'localhost'), where auto-initialize would demand a
+    coordinator and fail. Scheduler vars like SLURM_NTASKS /
+    OMPI_COMM_WORLD_SIZE are deliberately NOT hints: they are also set for
+    single-process runs inside an allocation (tasks reserved for dataloaders
+    etc.) — SLURM/MPI users pass the explicit JAX_* env vars instead.
+    """
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hosts.split(",") if h.strip()]) > 1:
+        return True
+    return bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
 
 
 def initialize(coordinator_address: str | None = None,
@@ -74,9 +79,11 @@ def initialize(coordinator_address: str | None = None,
         process_id = int(env_i)
     if coordinator_address is None and num_processes is None:
         # no explicit cluster spec: hand off to jax's auto-detection ONLY in
-        # environments that advertise one (TPU pod / SLURM / OpenMPI) — a
-        # plain single-host run must not risk a coordinator connect attempt
-        if any(os.environ.get(k) for k in _CLUSTER_ENV_HINTS):
+        # unambiguously multi-worker environments (a single-host run must
+        # not risk a coordinator connect attempt). A failure here must
+        # PROPAGATE: degrading one worker of a real pod to an independent
+        # single-host run would corrupt the shared log/checkpoint paths
+        if _looks_multiworker():
             jax.distributed.initialize()
         return
     jax.distributed.initialize(

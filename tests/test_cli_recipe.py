@@ -1,0 +1,102 @@
+"""The paper's two-stage recipe through the REAL CLIs (SURVEY.md §3.5):
+
+    stage 1: WXE (consensus-weighted cross-entropy) training
+    stage 2: CST fine-tune from the stage-1 checkpoint (rl.init_from)
+    then:    beam eval of the fine-tuned checkpoint
+
+Covers the two paths nothing else exercises end-to-end: ``train.loss='wxe'``
+through the Trainer and the ``--skip-xe`` + ``rl__init_from`` handoff.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def recipe_data(tmp_path_factory):
+    from cst_captioning_tpu.data import make_synthetic_dataset
+    from cst_captioning_tpu.data.preprocess import compute_consensus_weights
+
+    root = tmp_path_factory.mktemp("recipe")
+    paths = make_synthetic_dataset(
+        str(root), num_videos=16, num_topics=3, vocab_words=20,
+        modalities={"resnet": 12}, max_frames=4, seed=13,
+    )
+    info = json.load(open(paths["info_json"]))
+    tok = {
+        v["id"]: [c.split() for c in v["captions"]]
+        for v in info["videos"] if v["split"] == "train"
+    }
+    weights = compute_consensus_weights(tok)
+    w_path = str(root / "consensus_weights.npz")
+    np.savez(w_path, **weights)
+    paths["consensus_weights"] = w_path
+    # info['vocab'] already includes the 4 special tokens
+    paths["vocab_size"] = len(info["vocab"])
+    return paths
+
+
+def _common(paths):
+    return [
+        "--info-json", paths["info_json"],
+        "--feature", f"resnet={paths['resnet']}",
+        "--set", f"model__vocab_size={paths['vocab_size']}",
+        "--set", "model__modalities=(('resnet',12),)",
+        "--set", "model__d_embed=12", "--set", "model__d_hidden=12",
+        "--set", "model__d_att=8", "--set", "model__max_len=8",
+        "--set", "model__max_frames=4", "--set", "model__dtype='float32'",
+        "--set", "data__batch_size=8", "--set", "data__seq_per_vid=3",
+    ]
+
+
+def test_two_stage_recipe_via_clis(recipe_data, tmp_path):
+    from cst_captioning_tpu.cli.eval import main as eval_main
+    from cst_captioning_tpu.cli.train import main as train_main
+
+    xe_ckpt = str(tmp_path / "xe")
+    rl_ckpt = str(tmp_path / "rl")
+    log1 = str(tmp_path / "stage1.jsonl")
+    log2 = str(tmp_path / "stage2.jsonl")
+
+    # stage 1: consensus-weighted XE
+    train_main([
+        "--preset", "msrvtt_xe_attention", *_common(recipe_data),
+        "--set", "train__loss='wxe'", "--set", "train__lr=5e-3",
+        "--set", f"data__consensus_weights='{recipe_data['consensus_weights']}'",
+        "--set", "train__epochs=3", "--set", "train__eval_every_epochs=3",
+        "--log-jsonl", log1,
+        "--set", f"train__ckpt_dir='{xe_ckpt}'",
+    ])
+    ev1 = [json.loads(l) for l in open(log1)]
+    xe_losses = [e["loss"] for e in ev1 if e["event"] == "xe_epoch"]
+    assert len(xe_losses) == 3 and xe_losses[-1] < xe_losses[0]
+    assert os.path.exists(os.path.join(xe_ckpt, "best", "state.msgpack"))
+
+    # stage 2: CST fine-tune FROM the stage-1 best checkpoint, RL only
+    train_main([
+        "--preset", "msrvtt_scst", *_common(recipe_data), "--skip-xe",
+        "--set", f"rl__init_from='{xe_ckpt}'",
+        "--set", "rl__epochs=2", "--set", "rl__num_rollouts=3",
+        "--set", "train__eval_every_epochs=1",
+        "--log-jsonl", log2,
+        "--set", f"train__ckpt_dir='{rl_ckpt}'",
+    ])
+    ev2 = [json.loads(l) for l in open(log2)]
+    assert [e for e in ev2 if e["event"] == "handoff"], "no XE->RL handoff"
+    rl = [e for e in ev2 if e["event"] == "rl_epoch"]
+    assert len(rl) == 2 and all(np.isfinite(e["reward"]) for e in rl)
+    assert os.path.exists(os.path.join(rl_ckpt, "latest", "state.msgpack"))
+
+    # eval the fine-tuned checkpoint with beam search
+    res = str(tmp_path / "results.json")
+    eval_main([
+        "--preset", "msrvtt_eval_beam5", *_common(recipe_data),
+        "--ckpt-dir", rl_ckpt, "--ckpt-name", "latest", "--split", "test",
+        "--set", "eval__beam_size=3", "--set", "eval__max_len=8",
+        "--results-json", res,
+    ])
+    result = json.load(open(res))
+    assert result["captions"] and np.isfinite(result["metrics"]["CIDEr-D"])
