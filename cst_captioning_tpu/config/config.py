@@ -205,6 +205,16 @@ class ExperimentConfig:
                 "sequence-parallel ('seq_devices > 1') path; use one or the "
                 "other"
             )
+        if self.rl.enabled and (
+            self.rl.update_chunks < 1
+            or self.rl.num_rollouts % self.rl.update_chunks
+        ):
+            # catch at config time, not at the first RL step after a
+            # potentially multi-hour XE phase
+            raise ValueError(
+                f"rl.update_chunks {self.rl.update_chunks} must be >= 1 and "
+                f"divide rl.num_rollouts {self.rl.num_rollouts}"
+            )
 
     # ---- serialization ----------------------------------------------------
 

@@ -33,8 +33,13 @@ import os
 
 import jax
 import numpy as np
-from jax.experimental import multihost_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# NOTE: jax.experimental.multihost_utils must NOT be imported at module
+# level: importing it initializes the XLA backend, after which a later
+# jax.distributed.initialize silently degrades to a single-process cluster
+# (observed empirically: procs=1, XLA_FLAGS ignored). It is imported lazily
+# inside the helpers, all of which run long after initialization.
 
 
 def _looks_multiworker() -> bool:
@@ -68,6 +73,13 @@ def initialize(coordinator_address: str | None = None,
     # backend-initializing call before jax.distributed.initialize is an error
     if jax.distributed.is_initialized():
         return
+    if os.environ.get("JAX_PLATFORMS"):
+        # pin the platform list via config BEFORE distributed init: with a
+        # registered out-of-tree PJRT plugin, the env var alone is not
+        # honored by the distributed handshake and init silently degrades to
+        # a single-process cluster (observed: procs=1 and XLA_FLAGS ignored
+        # unless this config is set first)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )
@@ -85,6 +97,22 @@ def initialize(coordinator_address: str | None = None,
         # single-host run would corrupt the shared log/checkpoint paths
         if _looks_multiworker():
             jax.distributed.initialize()
+            return
+        # scheduler says multiple tasks but no JAX_* cluster spec: each rank
+        # would train independently and race the shared checkpoint dir —
+        # make the misconfiguration loud (we deliberately don't auto-init
+        # from these vars; see _looks_multiworker)
+        for var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"):
+            val = os.environ.get(var, "")
+            if val.isdigit() and int(val) > 1:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s=%s but no JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/"
+                    "JAX_PROCESS_ID set: every rank will run SINGLE-HOST on "
+                    "the full dataset and race shared output paths. Pass the "
+                    "JAX_* env vars to form one cluster.", var, val,
+                )
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -95,6 +123,26 @@ def initialize(coordinator_address: str | None = None,
 
 def is_multiprocess() -> bool:
     return jax.process_count() > 1
+
+
+def assert_seq_axis_within_host(device_grid) -> None:
+    """Reject a 2-D ``('data','seq')`` device grid whose seq rows span
+    processes.
+
+    Host-sharded batch feeding partitions the 'data' axis by process; a seq
+    row spanning hosts would psum frame shards of DIFFERENT videos — silent
+    divergence (reproduced on a real 2-process cluster). Checks the ACTUAL
+    device placement, not a local-count proxy: device-id order need not be
+    process-contiguous on every topology.
+    """
+    for row in device_grid:
+        procs = {d.process_index for d in row}
+        if len(procs) > 1:
+            raise ValueError(
+                f"the mesh's 'seq' axis spans processes ({sorted(procs)}); "
+                "pick mesh.seq_devices so every seq row stays on one host "
+                "(host-sharded feeding partitions 'data' by process)"
+            )
 
 
 def host_shard() -> tuple[int, int]:
@@ -167,6 +215,8 @@ def to_host_local(arr, mesh: Mesh, spec: P) -> np.ndarray:
     path). Single-process: plain ``np.asarray``."""
     if not is_multiprocess():
         return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
     local = multihost_utils.global_array_to_host_local_array(arr, mesh, spec)
     return np.asarray(local)
 
@@ -176,6 +226,8 @@ def from_host_local(arr, mesh: Mesh, spec: P):
     Single-process: the identity."""
     if not is_multiprocess():
         return arr
+    from jax.experimental import multihost_utils
+
     return multihost_utils.host_local_array_to_global_array(
         np.asarray(arr), mesh, spec
     )
@@ -186,6 +238,8 @@ def allgather_to_host(arr) -> np.ndarray:
     Single-process: plain ``np.asarray``."""
     if not is_multiprocess():
         return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
@@ -195,6 +249,8 @@ def global_scalar_mean(x: float) -> float:
     Single-process: the identity."""
     if not is_multiprocess():
         return float(x)
+    from jax.experimental import multihost_utils
+
     return float(
         np.mean(multihost_utils.process_allgather(np.asarray(x, np.float64)))
     )

@@ -110,6 +110,8 @@ class Trainer:
                     f"model.max_frames {cfg.model.max_frames} must be "
                     f"divisible by mesh.seq_devices {self.mesh.shape['seq']}"
                 )
+            if self.sp and multihost.is_multiprocess():
+                multihost.assert_seq_axis_within_host(self.mesh.devices)
 
         # multi-host: each process collates only its slice of every global
         # batch (identical global order — the shuffle is epoch-keyed);
@@ -146,6 +148,15 @@ class Trainer:
         else:
             self.xe_step = make_xe_step(self.model, cfg.train.label_smoothing)
 
+        if multihost.is_multiprocess():
+            # verifiable evidence the cluster actually formed (a degraded
+            # init would silently train N independent copies)
+            self.log.log(
+                "distributed",
+                processes=jax.process_count(),
+                process_index=jax.process_index(),
+                devices=len(jax.devices()),
+            )
         self.ckpt = CheckpointManager(cfg.train.ckpt_dir, metric="CIDEr-D")
         self.epoch = 0        # global epoch counter (batch-order key, logging)
         self.xe_epochs = 0    # per-phase progress: epochs-field budgets are

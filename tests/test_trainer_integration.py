@@ -390,6 +390,57 @@ def test_trainer_seq_devices_rejects_indivisible_frames(datasets):
         Trainer(cfg, train_ds, None)
 
 
+def test_trainer_rejects_seq_axis_spanning_hosts(datasets, monkeypatch, tmp_path):
+    """Multi-host + a 'seq' axis wider than one process's devices would psum
+    frame shards of DIFFERENT videos (host-sharded feeding partitions 'data'
+    by process) — must be rejected, not silently diverge."""
+    from types import SimpleNamespace
+
+    import numpy as np
+
+    from cst_captioning_tpu.config.config import MeshConfig
+    from cst_captioning_tpu.train import multihost
+
+    # the placement check itself, on a fabricated 2x4 grid whose seq rows
+    # mix two processes (device-id order need not be process-contiguous)
+    def dev(pid):
+        return SimpleNamespace(process_index=pid)
+
+    bad = np.array([[dev(0), dev(0), dev(1), dev(1)]] * 2)
+    with pytest.raises(ValueError, match="spans processes"):
+        multihost.assert_seq_axis_within_host(bad)
+    good = np.array([[dev(0)] * 4, [dev(1)] * 4])
+    multihost.assert_seq_axis_within_host(good)  # no raise
+
+    # and the Trainer wires it: with multi-process faked, the single-process
+    # test grid (all process_index 0) passes placement and training proceeds
+    # to the batcher — so just pin that the check is invoked
+    called = []
+    monkeypatch.setattr(multihost, "is_multiprocess", lambda: True)
+    monkeypatch.setattr(
+        multihost, "assert_seq_axis_within_host",
+        lambda grid: called.append(grid.shape),
+    )
+    # host_shard would also see the fake multiprocess: keep it single
+    monkeypatch.setattr(multihost, "host_shard", lambda: (0, 1))
+    train_ds, _ = datasets
+    cfg = make_cfg(str(tmp_path / "ckpt"), len(train_ds.vocab))
+    cfg = dataclasses.replace(cfg, mesh=MeshConfig(seq_devices=4))
+    Trainer(cfg, train_ds, None)
+    assert called == [(2, 4)]
+
+
+def test_config_rejects_indivisible_update_chunks():
+    from cst_captioning_tpu.config.config import ExperimentConfig, RLConfig
+
+    with pytest.raises(ValueError, match="update_chunks"):
+        ExperimentConfig(
+            rl=RLConfig(enabled=True, num_rollouts=5, update_chunks=4)
+        )
+    # valid combinations construct fine
+    ExperimentConfig(rl=RLConfig(enabled=True, num_rollouts=4, update_chunks=2))
+
+
 def test_resume_logs_config_drift(datasets, tmp_path_factory):
     train_ds, _ = datasets
     ckpt_dir = str(tmp_path_factory.mktemp("ckptdrift"))
