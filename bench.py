@@ -4,8 +4,10 @@ Measures clips/sec/chip of the full CST self-critical step on the flagship
 MSR-VTT configuration (BASELINE config 4: temporal-attention encoder,
 ResNet+C3D features, K=5 Monte-Carlo rollouts, CIDEr-D(+BLEU4) consensus
 reward), run through the production pipelined path
-(:meth:`SCSTTrainer.train_epoch`): the host scores batch *i* while the device
-decodes batch *i+1*, exactly as ``Trainer.train_rl`` does.
+(:meth:`SCSTTrainer.train_epoch`): per iteration the dispatch order is
+update(i-2) -> decode(i) -> host-score(i-1), so the host reward overlaps a
+full device step (update + decode) and the device never idles on it —
+exactly as ``Trainer.train_rl`` does.
 
 Prints ONE JSON line:
     {"metric": "rl_clips_per_sec_per_chip", "value": N, "unit": "clips/s/chip",

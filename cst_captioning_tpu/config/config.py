@@ -58,8 +58,10 @@ class ModelConfig:
     seq_axis: str = ""
     # temporal-attention context implementation: "xla" (the fused composite
     # XLA compiles, default) or "pallas" (ops/attention_pallas.py — blockwise
-    # online softmax over the frame axis keeping the [B, M, d_att] tanh
-    # intermediate in VMEM; parity-tested, for long-context frame counts)
+    # online softmax over the frame axis; parity-tested. Measured on v5e:
+    # XLA ties or beats it (within ±10%) at every M up to 8192 — see
+    # BENCH_ATTENTION.json — so "xla" is recommended everywhere; the kernel
+    # is long-context insurance)
     attention_impl: str = "xla"
 
     def __post_init__(self):
@@ -148,6 +150,27 @@ class RLConfig:
     lr: float = 2e-5                    # RL phase LR (fresh optimizer on handoff)
     epochs: int = 20
     init_from: str = ""                 # XE checkpoint to start from
+    # True (default): the two-stage pipelined epoch — per iteration the
+    # dispatch order is update(i-2) -> decode(i) -> host-score(i-1), so a
+    # full device step stays queued while the host computes the consensus
+    # reward and the device never idles on it. The decoded policy is one
+    # update stale (identical to a plain decode-then-score loop — update
+    # i-1 cannot be ready before decode i without blocking). False: strict
+    # on-policy SCST, decode -> score -> update serialized per batch,
+    # exactly the reference's loop (SURVEY.md §3.2); measured reward-curve
+    # delta between the modes is recorded in BASELINE.md
+    pipelined: bool = True
+    # host threads for the native consensus-reward scorer; 0 = all cores
+    # (os.cpu_count()). The reward is the host hot path the pipeline hides —
+    # size it to the machine, not a hardcoded cap
+    reward_threads: int = 0
+    # scale applied to sentence-BLEU4 (in [0,1]) before mixing with CIDEr-D
+    # (x10 scale) in the consensus reward: reward = w_c*CIDErD +
+    # w_b*BLEU4*scale. Default 10.0 puts both terms on a like scale —
+    # UNVERIFIED interpretation of the reference's convention (BASELINE.md
+    # "Mixed-reward BLEU4 scale"); exposed so it can be matched when the
+    # reference becomes readable
+    reward_bleu4_scale: float = 10.0
     # gradient accumulation over the K rollout axis in the REINFORCE update:
     # the update teacher-forces K*B sequences at once, which caps the batch
     # size under HBM; update_chunks=C (dividing K) re-runs forward+backward
@@ -214,6 +237,11 @@ class ExperimentConfig:
             raise ValueError(
                 f"rl.update_chunks {self.rl.update_chunks} must be >= 1 and "
                 f"divide rl.num_rollouts {self.rl.num_rollouts}"
+            )
+        if self.rl.reward_threads < 0:
+            raise ValueError(
+                f"rl.reward_threads {self.rl.reward_threads} must be >= 0 "
+                "(0 = all cores)"
             )
 
     # ---- serialization ----------------------------------------------------

@@ -150,6 +150,8 @@ class RewardComputer:
         df: CorpusDF | None = None,
         cider_weight: float = 1.0,
         bleu_weight: float = 0.0,
+        bleu_scale: float = 10.0,
+        num_threads: int = 0,
         use_native: bool = True,
     ):
         self.vocab = vocab
@@ -161,6 +163,16 @@ class RewardComputer:
         self.log_ndoc = math.log(max(float(df.num_docs), math.e))
         self.cider_weight = cider_weight
         self.bleu_weight = bleu_weight
+        # BLEU4 is in [0,1] vs CIDEr's x10 scale; bleu_scale (config
+        # rl.reward_bleu4_scale) maps it onto the mixing scale. UNVERIFIED
+        # interpretation of the reference's convention — see BASELINE.md
+        # "Mixed-reward BLEU4 scale"
+        self.bleu_scale = bleu_scale
+        # 0 = all cores: the reward is the host hot path of the RL phase and
+        # the pipelined epoch hides exactly as much of it as the threads cover
+        import os
+
+        self.num_threads = num_threads if num_threads > 0 else (os.cpu_count() or 1)
         self._native = None
         if use_native:
             self._init_native(refs)
@@ -286,16 +298,15 @@ class RewardComputer:
                 counts, stats, self.df, self.log_ndoc
             )
             if self.bleu_weight != 0.0:
-                # BLEU in [0,1] vs CIDEr's ×10 scale: scale BLEU4 ×10 onto a
-                # like scale. UNVERIFIED interpretation of the reference's
-                # convention — see BASELINE.md "Mixed-reward BLEU4 scale"
-                r += self.bleu_weight * _bleu4_score(hyp, counts, stats) * 10.0
+                r += (
+                    self.bleu_weight * _bleu4_score(hyp, counts, stats)
+                    * self.bleu_scale
+                )
             rewards[i] = r
         return rewards
 
     def _score_native(self, video_ids, token_rows, n, nv) -> np.ndarray:
         import ctypes
-        import os
 
         from cst_captioning_tpu.config.config import UNK_ID
 
@@ -316,8 +327,10 @@ class RewardComputer:
             ctypes.c_int64(n),
             ctypes.c_int32(token_rows.shape[1]),
             ctypes.c_double(self.cider_weight),
-            ctypes.c_double(self.bleu_weight),
-            ctypes.c_int32(min(os.cpu_count() or 1, 8)),
+            # the kernel mixes bw*BLEU4*10 (its fixed x10 convention); fold
+            # the configurable scale into the weight so bw_eff*10 == w_b*scale
+            ctypes.c_double(self.bleu_weight * self.bleu_scale / 10.0),
+            ctypes.c_int32(self.num_threads),
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         )
         return out

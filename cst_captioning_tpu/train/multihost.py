@@ -254,3 +254,21 @@ def global_scalar_mean(x: float) -> float:
     return float(
         np.mean(multihost_utils.process_allgather(np.asarray(x, np.float64)))
     )
+
+
+def global_weighted_mean(value_sum: float, weight: float) -> float:
+    """``sum(value_sum)/sum(weight)`` across processes (one tiny collective):
+    the exact cross-host mean when hosts contribute unequal row counts (e.g.
+    wrap-padded final RL batches). Single-process: the local ratio.
+    A zero total weight returns 0.0 (fractional weights stay undistorted)."""
+    if not is_multiprocess():
+        total_v, total_w = float(value_sum), float(weight)
+    else:
+        from jax.experimental import multihost_utils
+
+        pair = multihost_utils.process_allgather(
+            np.asarray([value_sum, weight], np.float64)
+        )
+        total = np.sum(np.asarray(pair).reshape(-1, 2), axis=0)
+        total_v, total_w = float(total[0]), float(total[1])
+    return total_v / total_w if total_w > 0.0 else 0.0

@@ -366,7 +366,17 @@ class Trainer:
             # resumed mid-RL: the restored opt_state/step already belong to the
             # RL optimizer (saved during RL) — keep the Adam moments and
             # schedule position, just re-attach the non-serialized tx. The
-            # structures match: make_optimizer differs only in LR value.
+            # structures must match (make_optimizer differs only in LR value);
+            # verify rather than assume, so a future phase-specific optimizer
+            # change cannot silently misinterpret the restored moments
+            fresh = jax.eval_shape(tx.init, self.state.params)
+            if jax.tree.structure(fresh) != jax.tree.structure(self.state.opt_state):
+                raise RuntimeError(
+                    "mid-RL resume: the checkpoint's opt_state tree does not "
+                    "match the RL optimizer built from this config — the "
+                    "restored Adam moments would be misinterpreted. Did the "
+                    "optimizer definition change between runs?"
+                )
             self.state = self.state.replace(tx=tx)
 
         # df=None lets RewardComputer build the train-pool df itself
@@ -377,6 +387,8 @@ class Trainer:
             df=df,
             cider_weight=cfg.rl.reward_cider_weight,
             bleu_weight=cfg.rl.reward_bleu4_weight,
+            bleu_scale=cfg.rl.reward_bleu4_scale,
+            num_threads=cfg.rl.reward_threads,
         )
         scst = SCSTTrainer(
             self.model, reward, cfg.rl, mesh=self.mesh, max_len=cfg.model.max_len
@@ -408,9 +420,11 @@ class Trainer:
         for _ in range(epochs):
             timer.reset()
             rewards = []
+            valid_rows = []
 
             def on_step(m):
                 rewards.append(m["reward_mean"])
+                valid_rows.append(m["valid_rows"])
                 step_counter["step"] += 1
                 if log_every and step_counter["step"] % log_every == 0:
                     self.log.log(
@@ -428,14 +442,16 @@ class Trainer:
                 else:
                     timer.tick(cfg.data.batch_size)
 
-            # pipelined epoch: host reward for batch i overlaps device decode
-            # of batch i+1; batches are prefetched to device by a host thread
+            # pipelined epoch (rl.pipelined, default): host reward for batch i
+            # overlaps device update i-1 + decode i+1; batches are prefetched
+            # to device by a host thread. pipelined=False: strict on-policy
             ep_rng = jax.random.fold_in(base_rng, self.epoch)
             self.state, _ = scst.train_epoch(
                 self.state,
                 self._rl_device_batches(rl_batcher),
                 ep_rng,
                 on_step=on_step,
+                pipelined=cfg.rl.pipelined,
             )
             profiler.stop()
             self.epoch += 1
@@ -443,9 +459,12 @@ class Trainer:
             self.log.log(
                 "rl_epoch",
                 epoch=self.epoch,
-                # per-step rewards are scored on this host's rows only; the
-                # epoch stat reduces across processes (equal rows per host)
-                reward=multihost.global_scalar_mean(float(np.mean(rewards))),
+                # per-step rewards are scored on this host's rows only; weight
+                # by valid rows (wrap-padded final batches have fewer) and
+                # reduce exactly across processes
+                reward=multihost.global_weighted_mean(
+                    float(np.dot(rewards, valid_rows)), float(np.sum(valid_rows))
+                ),
                 clips_per_sec=timer.clips_per_sec,
             )
             last_val = self._validate_and_checkpoint()

@@ -170,6 +170,10 @@ def test_helpers_single_process_identity():
     )
     assert multihost.from_host_local(arr, mesh, P("data")) is arr
     np.testing.assert_array_equal(multihost.allgather_to_host(arr), tree[1])
+    assert multihost.global_scalar_mean(2.5) == 2.5
+    # weighted mean: local ratio, zero-weight guarded
+    assert multihost.global_weighted_mean(6.0, 4.0) == pytest.approx(1.5)
+    assert multihost.global_weighted_mean(0.0, 0.0) == 0.0
 
 
 # ---- 3. the real thing: 2-process cluster == single-process ----------------
