@@ -119,6 +119,7 @@ class CaptionDataset:
             for name, path in feature_files.items()
         }
         self.max_frames = max_frames
+        self._gts_pool: dict[str, list[str]] | None = None
         if consensus_weights:
             if not os.path.exists(consensus_weights):
                 raise FileNotFoundError(
@@ -150,8 +151,14 @@ class CaptionDataset:
         return {name: store.get(video_id) for name, store in self.stores.items()}
 
     def gts_pool(self) -> dict[str, list[str]]:
-        """video_id -> list of tokenized GT caption strings (reward/eval refs)."""
-        return {r.video_id: list(r.captions) for r in self.records}
+        """video_id -> list of tokenized GT caption strings (reward/eval refs).
+
+        Cached after the first call (records are immutable post-init); callers
+        treat the returned pool as read-only.
+        """
+        if self._gts_pool is None:
+            self._gts_pool = {r.video_id: list(r.captions) for r in self.records}
+        return self._gts_pool
 
     def close(self):
         for s in self.stores.values():

@@ -72,8 +72,15 @@ class Evaluator:
                     f"dataset max_frames {dataset.max_frames} must be "
                     f"divisible by the mesh's 'seq' axis {mesh.shape['seq']}"
                 )
+        # multi-host: each process collates/decodes only its own rows and
+        # the caption dicts are merged once per split (SURVEY.md §5
+        # dist-comm row) — host h5/collate/score work divides by process
+        # count instead of being replicated everywhere
+        self.multiproc = mesh is not None and multihost.is_multiprocess()
         self.batcher = Batcher(
-            dataset, batch_size=batch_size, max_len=self.cfg.max_len, mode="video"
+            dataset, batch_size=batch_size, max_len=self.cfg.max_len,
+            mode="video",
+            host_shard=multihost.host_shard() if self.multiproc else (0, 1),
         )
         W, T, lp = self.cfg.beam_size, self.cfg.max_len, self.cfg.length_penalty
         ml = self.cfg.min_len
@@ -121,34 +128,57 @@ class Evaluator:
     def generate(self, params) -> dict[str, str]:
         """Decode every video of the split -> {video_id: caption string}.
 
-        Multi-host: every process iterates the same (unsharded) batches,
-        placement extracts each host's shards from the replicated input, and
-        the decoded tokens are allgathered so every process returns the full
-        caption dict (train/multihost.py)."""
+        Multi-host: each process collates only its contiguous slice of every
+        global batch (the Batcher ``host_shard`` path the Trainer uses),
+        reads back only its own decoded rows, and the per-host caption dicts
+        are merged with ONE gather at the end — so the host-side h5 reads
+        and collates divide by process count while every process still
+        returns the full dict (train/multihost.py)."""
         out: dict[str, str] = {}
         for batch in self.batcher.epoch(shuffle=False):
             if self._fm_shardings is not None:
                 # numpy straight into the target sharding (single transfer)
-                feats, masks = multihost.put_full_global(
+                put = (
+                    multihost.put_global if self.multiproc
+                    else multihost.put_full_global
+                )
+                feats, masks = put(
                     self._fm_shardings, (batch.feats, batch.feat_masks)
                 )
             else:
                 feats, masks, *_ = batch_arrays(batch)
-            tokens = multihost.allgather_to_host(
-                self._decode(params, feats, masks)
-            )
+            tokens = self._decode(params, feats, masks)
+            if self.multiproc:
+                # this host's decoded rows only — batch.video_ids/valid are
+                # already the matching local slice
+                tokens = multihost.to_host_local(tokens, self.mesh, P("data"))
+            else:
+                tokens = np.asarray(tokens)
             for i, ok in enumerate(batch.valid):
                 if ok:
                     out[batch.video_ids[i]] = self.ds.vocab.decode(tokens[i])
+        if self.multiproc:
+            merged: dict[str, str] = {}
+            for part in multihost.allgather_pyobj(out):
+                merged.update(part)
+            out = merged
         return out
 
     def evaluate(self, params, results_json: str = "") -> dict[str, Any]:
-        """generate + score; optionally write the results json."""
+        """generate + score; optionally write the results json.
+
+        Multi-host: only process 0 runs the metric scorers (pure host
+        compute on inputs every process already holds); the metrics dict is
+        broadcast so the return value is identical everywhere."""
         captions = self.generate(params)
-        gts = {vid: list(caps) for vid, caps in self.ds.gts_pool().items()}
-        res = {vid: [captions[vid]] for vid in captions}
-        scorer = CaptionScorer(metrics=self.cfg.metrics)
-        metrics = scorer.score(gts, res)
+        metrics = None
+        if not self.multiproc or jax.process_index() == 0:
+            gts = {vid: list(caps) for vid, caps in self.ds.gts_pool().items()}
+            res = {vid: [captions[vid]] for vid in captions}
+            scorer = CaptionScorer(metrics=self.cfg.metrics)
+            metrics = scorer.score(gts, res)
+        if self.multiproc:
+            metrics = multihost.broadcast_pyobj(metrics)
         result = {"split": self.ds.split, "metrics": metrics, "captions": captions}
         if results_json:
             os.makedirs(os.path.dirname(results_json) or ".", exist_ok=True)

@@ -26,12 +26,21 @@ class CaptionScorer:
     runs everything (BASELINE.json config 5).
     """
 
+    KNOWN = ("Bleu", "ROUGE_L", "METEOR_approx", "CIDEr", "CIDEr-D")
+
     def __init__(
         self,
-        metrics: Sequence[str] = ("Bleu", "ROUGE_L", "METEOR_approx", "CIDEr", "CIDEr-D"),
+        metrics: Sequence[str] = KNOWN,
         cider_df: "CorpusDF | str" = "corpus",
         pre_tokenized: bool = False,
     ):
+        unknown = [m for m in metrics if m not in self.KNOWN]
+        if unknown:
+            # a misspelled selector silently producing an empty/partial table
+            # would fake a metric regression (or hide one) downstream
+            raise ValueError(
+                f"unknown metric selector(s) {unknown}; known: {list(self.KNOWN)}"
+            )
         self.metrics = tuple(metrics)
         self.cider_df = cider_df
         self.pre_tokenized = pre_tokenized

@@ -29,6 +29,7 @@ regular test suite and the 2-process parity test
 
 from __future__ import annotations
 
+import json
 import os
 
 import jax
@@ -254,6 +255,40 @@ def global_scalar_mean(x: float) -> float:
     return float(
         np.mean(multihost_utils.process_allgather(np.asarray(x, np.float64)))
     )
+
+
+def allgather_pyobj(obj) -> list:
+    """One JSON-serializable host object per process -> every process gets
+    ``[obj_0, ..., obj_{P-1}]`` in process order. Two tiny collectives (byte
+    lengths, then max-padded utf-8 bytes) regardless of payload structure —
+    the host-sharded eval's once-per-split caption merge. Single-process:
+    ``[obj]``."""
+    if not is_multiprocess():
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    data = np.frombuffer(
+        json.dumps(obj, default=float).encode("utf-8"), dtype=np.uint8
+    )
+    lengths = np.asarray(
+        multihost_utils.process_allgather(np.asarray(data.size, np.int64))
+    ).reshape(-1)
+    padded = np.zeros((int(lengths.max()),), np.uint8)
+    padded[: data.size] = data
+    rows = np.asarray(multihost_utils.process_allgather(padded))
+    return [
+        json.loads(rows[i, : int(lengths[i])].tobytes().decode("utf-8"))
+        for i in range(rows.shape[0])
+    ]
+
+
+def broadcast_pyobj(obj):
+    """Process 0's JSON-serializable object -> every process (the sharded
+    eval's metric fan-out: one process scores, the rest receive). Non-zero
+    processes' ``obj`` is ignored. Single-process: the object itself."""
+    if not is_multiprocess():
+        return obj
+    return allgather_pyobj(obj if jax.process_index() == 0 else None)[0]
 
 
 def global_weighted_mean(value_sum: float, weight: float) -> float:

@@ -79,16 +79,25 @@ def run_training(data_dir: str, ckpt_dir: str) -> dict:
     tr = Trainer(cfg, train_ds, None, use_mesh=True)
     tr.train_xe()
     tr.train_rl()
-    ev = Evaluator(tr.model, test_ds, EvalConfig(beam_size=2, max_len=8),
+    ev = Evaluator(tr.model, test_ds,
+                   EvalConfig(beam_size=2, max_len=8,
+                              metrics=("CIDEr-D", "Bleu")),
                    batch_size=8, mesh=tr.mesh)
-    captions = ev.generate(tr.state.params)
+    result = ev.evaluate(tr.state.params)
     leaf_sums = [
         float(np.asarray(x, np.float64).sum())
         for x in jax.tree_util.tree_leaves(jax.device_get(tr.state.params))
     ]
     train_ds.close()
     test_ds.close()
-    return {"leaf_sums": leaf_sums, "captions": captions}
+    return {
+        "leaf_sums": leaf_sums,
+        "captions": result["captions"],
+        "metrics": result["metrics"],
+        # evidence the eval host work is actually sharded: the per-process
+        # collate width (multi-process: global batch / process count)
+        "eval_local_batch": ev.batcher.local_batch_size,
+    }
 
 
 @pytest.fixture(scope="module")
@@ -176,6 +185,14 @@ def test_helpers_single_process_identity():
     assert multihost.global_weighted_mean(0.0, 0.0) == 0.0
 
 
+def test_pyobj_helpers_single_process():
+    from cst_captioning_tpu.train import multihost
+
+    obj = {"a": [1, 2], "b": "caption text"}
+    assert multihost.allgather_pyobj(obj) == [obj]
+    assert multihost.broadcast_pyobj(obj) is obj
+
+
 # ---- 3. the real thing: 2-process cluster == single-process ----------------
 
 
@@ -209,6 +226,12 @@ def test_two_process_cluster_matches_single_process(synth, tmp_path):
 
     multi = json.load(open(out_json))
     assert multi["captions"] == single["captions"]
+    # per-process eval host work is HALVED (host-sharded collate), yet the
+    # process-0-scored + broadcast metrics match the single-process ones
+    assert multi["eval_local_batch"] == single["eval_local_batch"] // 2
+    assert set(multi["metrics"]) == set(single["metrics"])
+    for k, v in single["metrics"].items():
+        assert multi["metrics"][k] == pytest.approx(v), k
     np.testing.assert_allclose(
         multi["leaf_sums"], single["leaf_sums"], rtol=1e-4, atol=1e-5
     )
