@@ -77,6 +77,11 @@ class Evaluator:
         # dist-comm row) — host h5/collate/score work divides by process
         # count instead of being replicated everywhere
         self.multiproc = mesh is not None and multihost.is_multiprocess()
+        # construct (and thereby validate) the scorer up front, on EVERY
+        # process: a bad metric selector failing only on process 0 after the
+        # full decode would leave the other processes hung in the metric
+        # broadcast collective
+        self._scorer = CaptionScorer(metrics=self.cfg.metrics)
         self.batcher = Batcher(
             dataset, batch_size=batch_size, max_len=self.cfg.max_len,
             mode="video",
@@ -175,11 +180,14 @@ class Evaluator:
         if not self.multiproc or jax.process_index() == 0:
             gts = {vid: list(caps) for vid, caps in self.ds.gts_pool().items()}
             res = {vid: [captions[vid]] for vid in captions}
-            scorer = CaptionScorer(metrics=self.cfg.metrics)
-            metrics = scorer.score(gts, res)
+            metrics = self._scorer.score(gts, res)
         if self.multiproc:
             metrics = multihost.broadcast_pyobj(metrics)
         result = {"split": self.ds.split, "metrics": metrics, "captions": captions}
+        if results_json and self.multiproc and jax.process_index() != 0:
+            # shared-filesystem contract (same as checkpointing): N identical
+            # concurrent writers can corrupt the file — process 0 writes
+            results_json = ""
         if results_json:
             os.makedirs(os.path.dirname(results_json) or ".", exist_ok=True)
             with open(results_json, "w") as f:

@@ -181,3 +181,58 @@ def test_dataset_rejects_missing_weights_and_empty_captions(synth, tmp_path):
     bad.write_text(json.dumps(info))
     with pytest.raises(ValueError, match="no captions"):
         CaptionDataset(str(bad), {"resnet": synth["resnet"]}, "train", 6)
+
+
+def test_synthetic_template_style(tmp_path):
+    """caption_style="template": same-topic videos share consensus n-gram
+    structure (noisy realizations of the topic's canonical phrases) while
+    different topics share none — the precondition bench_recipe.py's
+    XE-vs-CST comparison rests on. feature_noise scales the per-video
+    fingerprint amplitude."""
+    import collections
+    import json as _json
+
+    paths = make_synthetic_dataset(
+        str(tmp_path),
+        num_videos=24,
+        num_topics=2,
+        vocab_words=80,
+        captions_per_video=10,
+        caption_len=(5, 9),
+        modalities={"resnet": 16},
+        max_frames=4,
+        seed=11,
+        caption_style="template",
+        template_noise=0.2,
+        feature_noise=0.01,
+    )
+    info = _json.load(open(paths["info_json"]))
+    by_topic = collections.defaultdict(list)
+    for v in info["videos"]:
+        by_topic[v["topic"]].append(v)
+
+    def bigrams(video):
+        s = set()
+        for c in video["captions"]:
+            w = c.split()
+            s |= set(zip(w, w[1:]))
+        return s
+
+    t0, t1 = by_topic[0], by_topic[1]
+    same = bigrams(t0[0]) & bigrams(t0[1])
+    cross = bigrams(t0[0]) & bigrams(t1[0])
+    assert len(same) > 3       # consensus transfers across same-topic videos
+    assert len(cross) == 0     # disjoint word pools -> no cross-topic overlap
+
+    # low feature_noise: same-topic features nearly identical frame-to-frame
+    import h5py
+
+    with h5py.File(paths["resnet"], "r") as f:
+        a = np.asarray(f[t0[0]["id"]])
+        b = np.asarray(f[t0[1]["id"]])
+        x = np.asarray(f[t1[0]["id"]])
+    assert np.abs(a.mean(0) - b.mean(0)).max() < 0.1     # same topic: close
+    assert np.abs(a.mean(0) - x.mean(0)).max() > 0.5     # cross topic: far
+
+    with pytest.raises(ValueError, match="caption_style"):
+        make_synthetic_dataset(str(tmp_path / "bad"), caption_style="nope")
