@@ -106,6 +106,23 @@ def _xla_flops(jitted, *args) -> float:
         return float("nan")
 
 
+def _enc_and_per_tok_flops(
+    F=FRAMES, d=512, d_att=256, V=VOCAB, feat_dims=(2048, 500)
+) -> tuple[float, float]:
+    """(encoder-pass, per-decoded-token) matmul FLOPs of the flagship model
+    — the shared cost model for the RL and XE benches."""
+    M = len(feat_dims) * F
+    enc = 2 * F * sum(feat_dims) * d + 2 * M * d * d_att
+    per_tok = (
+        2 * d * d_att          # attention query projection
+        + 2 * M * d_att        # scores
+        + 2 * M * d            # context weighted sum
+        + 2 * 4 * d * (3 * d)  # LSTM: 4 gates x (input 2d [word+ctx] + hidden d)
+        + 2 * d * V            # output projection
+    )
+    return float(enc), float(per_tok)
+
+
 def _analytic_flops_per_clip(
     K=K_ROLLOUTS, T=MAX_LEN, F=FRAMES, d=512, d_att=256, V=VOCAB,
     feat_dims=(2048, 500),
@@ -121,18 +138,68 @@ def _analytic_flops_per_clip(
     scst._tile_feats) with a backward pass (~2x forward). Elementwise /
     softmax work is ignored (matmul-dominated).
     """
-    M = len(feat_dims) * F
-    enc = 2 * F * sum(feat_dims) * d + 2 * M * d * d_att
-    per_tok = (
-        2 * d * d_att          # attention query projection
-        + 2 * M * d_att        # scores
-        + 2 * M * d            # context weighted sum
-        + 2 * 4 * d * (3 * d)  # LSTM: 4 gates x (input 2d [word+ctx] + hidden d)
-        + 2 * d * V            # output projection
-    )
+    enc, per_tok = _enc_and_per_tok_flops(F, d, d_att, V, feat_dims)
     decode = 2 * enc + (1 + K) * T * per_tok
     update = 3 * K * (enc + T * per_tok)
     return float(decode + update)
+
+
+def _bench_xe(args, model, state, feats, masks, labels) -> None:
+    """XE-phase throughput: the teacher-forced forward+backward step on the
+    flagship model (one clip-row per clip; the production XE phase runs
+    seq_per_vid caption rows per video — clips/s here is ROW/s, the
+    apples-to-apples unit for the reference's batch-64 XE loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cst_captioning_tpu.train import make_xe_step
+
+    batch_size, measure_steps = args.batch, args.steps
+    n_chips = len(jax.devices())
+    step = make_xe_step(model)
+    mask = jnp.ones((batch_size, MAX_LEN), jnp.float32)
+    weights = jnp.ones((batch_size,), jnp.float32)
+
+    t0 = time.perf_counter()
+    state, m = step(state, feats, masks, labels, mask, weights)
+    jax.block_until_ready(state.params)
+    print(f"bench: xe compile+first step {time.perf_counter() - t0:.1f}s "
+          f"(loss={float(m['loss']):.3f})", file=sys.stderr)
+
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+    t0 = time.perf_counter()
+    for _ in range(measure_steps):
+        state, m = step(state, feats, masks, labels, mask, weights)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    if args.profile:
+        jax.profiler.stop_trace()
+
+    per_chip = batch_size * measure_steps / dt / max(n_chips, 1)
+    # forward+backward ~3x the forward matmul work of one teacher-forced row
+    # (encoder + T tokens) — the RL update term with K=1
+    enc, per_tok = _enc_and_per_tok_flops()
+    flops_per_row = 3 * (enc + MAX_LEN * per_tok)
+    kind = jax.devices()[0].device_kind
+    peak = _peak_flops(kind)
+    mfu = flops_per_row * batch_size * measure_steps / dt / peak / max(n_chips, 1)
+    print(
+        f"bench: xe {measure_steps} steps in {dt:.2f}s -> {per_chip:.1f} "
+        f"rows/s/chip (B={batch_size}, T={MAX_LEN}), mfu={mfu:.4f}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "xe_rows_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "rows/s/chip",
+        "batch": batch_size,
+        "max_len": MAX_LEN,
+        "flops_per_row_analytic": round(flops_per_row),
+        "mfu": round(mfu, 4),
+        "device_kind": kind,
+        "assumed_peak_bf16_flops": peak,
+    }))
 
 
 def main() -> None:
@@ -144,9 +211,13 @@ def main() -> None:
     ap.add_argument("--chunks", type=int, default=DEFAULT_CHUNKS,
                     help="rl.update_chunks (divides K=5; 1 = fused — the "
                          "fused update OOMs above --batch 512 on a 16G chip)")
+    ap.add_argument("--phase", choices=("rl", "xe"), default="rl",
+                    help="rl (default, the north-star metric) or xe: "
+                         "teacher-forced cross-entropy step throughput on "
+                         "the same flagship model")
     args = ap.parse_args()
     batch_size, measure_steps = args.batch, args.steps
-    if args.chunks == 1 and batch_size > 512:
+    if args.phase == "rl" and args.chunks == 1 and batch_size > 512:
         # fail before the multi-minute warmup compile, not after it
         sys.exit(
             f"bench: --chunks 1 (fused update) OOMs above --batch 512 on a "
@@ -189,6 +260,10 @@ def main() -> None:
 
     tx = make_optimizer(TrainConfig(lr=2e-5, grad_clip=5.0), 100)
     state = create_train_state(model, tx, (feats, masks, labels), seed=0)
+
+    if args.phase == "xe":
+        _bench_xe(args, model, state, feats, masks, labels)
+        return
 
     # synthetic consensus pools: 5 GT captions per video over a real vocab
     words = [f"w{i}" for i in range(VOCAB - 4)]

@@ -210,12 +210,12 @@ def run(args: argparse.Namespace) -> dict:
         )
         stage["test_metrics"] = metrics
         result[f"stage2_{mode}"] = stage
+        rc = stage["reward_curve"]
         print(json.dumps({
             "stage": f"cst_{mode}",
             "test_cider_d_beam5": metrics["beam5"]["CIDEr-D"],
             "test_cider_d_greedy": metrics["greedy"]["CIDEr-D"],
-            "reward_first_last": [stage["reward_curve"][0],
-                                  stage["reward_curve"][-1]],
+            "reward_first_last": [rc[0], rc[-1]] if rc else None,
             "seconds": stage["seconds"],
         }))
 

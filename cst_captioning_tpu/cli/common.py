@@ -91,4 +91,5 @@ def open_dataset(args: argparse.Namespace, cfg: ExperimentConfig,
         split=split,
         max_frames=cfg.model.max_frames,
         consensus_weights=cfg.data.consensus_weights,
+        cache_features=cfg.data.cache_features,
     )

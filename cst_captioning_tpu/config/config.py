@@ -101,6 +101,10 @@ class DataConfig:
     seq_per_vid: int = 1                # caption rows sampled per video (XE)
     shuffle_seed: int = 0
     prefetch: int = 2                   # device prefetch depth
+    # keep every video's (padded) features in host RAM after the first h5
+    # read: repeat epochs skip h5py entirely. Opt-in — full MSR-VTT
+    # ResNet+C3D at 28 frames is ~2 GB of f32; size it to the host
+    cache_features: bool = False
 
     def __post_init__(self):
         if isinstance(self.feature_files, Mapping):

@@ -90,6 +90,7 @@ class CaptionDataset:
         split: str,
         max_frames: int = 60,
         consensus_weights: str = "",
+        cache_features: bool = False,
     ):
         with open(info_json) as f:
             info = json.load(f)
@@ -120,6 +121,11 @@ class CaptionDataset:
         }
         self.max_frames = max_frames
         self._gts_pool: dict[str, list[str]] | None = None
+        # opt-in host-RAM feature cache (DataConfig.cache_features): h5 reads
+        # are the host hot path on repeat epochs — with the cache, each
+        # video's padded features are read once and every later epoch is a
+        # dict lookup. Memory = n_videos * max_frames * sum(dims) * 4 bytes
+        self._feat_cache: dict[str, dict] | None = {} if cache_features else None
         if consensus_weights:
             if not os.path.exists(consensus_weights):
                 raise FileNotFoundError(
@@ -148,6 +154,15 @@ class CaptionDataset:
         return len(self.records)
 
     def features_for(self, video_id: str) -> dict[str, tuple[np.ndarray, np.ndarray]]:
+        if self._feat_cache is not None:
+            hit = self._feat_cache.get(video_id)
+            if hit is None:
+                hit = {
+                    name: store.get(video_id)
+                    for name, store in self.stores.items()
+                }
+                self._feat_cache[video_id] = hit
+            return hit
         return {name: store.get(video_id) for name, store in self.stores.items()}
 
     def gts_pool(self) -> dict[str, list[str]]:

@@ -236,3 +236,27 @@ def test_synthetic_template_style(tmp_path):
 
     with pytest.raises(ValueError, match="caption_style"):
         make_synthetic_dataset(str(tmp_path / "bad"), caption_style="nope")
+
+
+def test_feature_cache_serves_without_h5(synth):
+    """cache_features=True: after a warm pass, features come from host RAM —
+    identical to the uncached reads, and served even once the h5 stores are
+    closed (proving repeat epochs do zero h5 IO)."""
+    cold = CaptionDataset(
+        synth["info_json"], {"resnet": synth["resnet"]}, "train", 6
+    )
+    warm = CaptionDataset(
+        synth["info_json"], {"resnet": synth["resnet"]}, "train", 6,
+        cache_features=True,
+    )
+    ids = [r.video_id for r in warm.records]
+    baseline = {v: cold.features_for(v) for v in ids}
+    for v in ids:
+        warm.features_for(v)
+    for s in warm.stores.values():
+        s.close()                      # h5 gone; cache must stand alone
+    for v in ids:
+        f, m = warm.features_for(v)["resnet"]
+        np.testing.assert_array_equal(f, baseline[v]["resnet"][0])
+        np.testing.assert_array_equal(m, baseline[v]["resnet"][1])
+    cold.close()
