@@ -161,6 +161,12 @@ class CaptionDataset:
                     name: store.get(video_id)
                     for name, store in self.stores.items()
                 }
+                for f, m in hit.values():
+                    # the same arrays are handed out on every hit: an
+                    # in-place consumer would silently poison later epochs —
+                    # make that an immediate ValueError instead
+                    f.flags.writeable = False
+                    m.flags.writeable = False
                 self._feat_cache[video_id] = hit
             return hit
         return {name: store.get(video_id) for name, store in self.stores.items()}

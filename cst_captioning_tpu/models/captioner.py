@@ -19,6 +19,7 @@ import functools
 
 import flax.linen as nn
 import flax.struct
+import jax
 import jax.numpy as jnp
 
 from cst_captioning_tpu.config.config import BOS_ID, ModelConfig
@@ -46,6 +47,23 @@ def shift_right(labels: jnp.ndarray) -> jnp.ndarray:
 
 def _scan_step(mdl, carry, token, memory, memory_proj, memory_mask, deterministic):
     return mdl.cell(carry, token, memory, memory_proj, memory_mask, deterministic)
+
+
+def _scan_step_logp(mdl, carry, tokens, memory, memory_proj, memory_mask,
+                    deterministic):
+    """One teacher-forced step emitting only the TARGET token's logprob.
+
+    The per-step ``[B, V]`` logits are consumed immediately (logsumexp +
+    gather fuse into the step), so the ``[B, T, V]`` stack never reaches
+    HBM — the point of :meth:`CaptionModel.teacher_force_logps`."""
+    token_in, token_tgt = tokens
+    carry, logits = mdl.cell(
+        carry, token_in, memory, memory_proj, memory_mask, deterministic
+    )
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, token_tgt[:, None], axis=-1)[:, 0]
+    return carry, tgt - lse
 
 
 class CaptionModel(nn.Module):
@@ -101,15 +119,18 @@ class CaptionModel(nn.Module):
 
     # ---- teacher forcing -----------------------------------------------------
 
-    def __call__(
+    def decode_logits(
         self,
-        feats: dict[str, jnp.ndarray],
-        masks: dict[str, jnp.ndarray],
+        enc: EncoderOutput,
         labels: jnp.ndarray,
         train: bool = False,
     ) -> jnp.ndarray:
-        """-> logits [B, T, V] (f32); logits[:, t] predicts labels[:, t]."""
-        enc = self.encode(feats, masks)
+        """Teacher-forced unroll from an already-built :class:`EncoderOutput`.
+
+        Split from :meth:`__call__` so callers that reuse one encoder pass
+        for many label rows (the REINFORCE update teacher-forces K rollouts
+        per clip against TILED memory — rl/scst.py) pay the encoder once
+        instead of per row."""
         inputs = shift_right(labels)
         scan = nn.scan(
             functools.partial(_scan_step, deterministic=not train),
@@ -122,3 +143,40 @@ class CaptionModel(nn.Module):
             self, enc.carry, inputs, enc.memory, enc.memory_proj, enc.memory_mask
         )
         return logits
+
+    def teacher_force_logps(
+        self,
+        enc: EncoderOutput,
+        labels: jnp.ndarray,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        """Per-position logprob of ``labels`` under teacher forcing: [B, T].
+
+        Equals ``sequence_log_probs(decode_logits(enc, labels), labels)``
+        (pinned by test) but never materializes the ``[B, T, V]`` logits
+        stack — at the flagship dims that array is ~2 GB of f32 per REINFORCE
+        chunk whose only use is a gather + logsumexp, pure HBM traffic the
+        in-scan form avoids (rl/scst.py's update path)."""
+        inputs = shift_right(labels)
+        scan = nn.scan(
+            functools.partial(_scan_step_logp, deterministic=not train),
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            in_axes=((1, 1), nn.broadcast, nn.broadcast, nn.broadcast),
+            out_axes=1,
+        )
+        _, logps = scan(
+            self, enc.carry, (inputs, labels), enc.memory, enc.memory_proj,
+            enc.memory_mask,
+        )
+        return logps
+
+    def __call__(
+        self,
+        feats: dict[str, jnp.ndarray],
+        masks: dict[str, jnp.ndarray],
+        labels: jnp.ndarray,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        """-> logits [B, T, V] (f32); logits[:, t] predicts labels[:, t]."""
+        return self.decode_logits(self.encode(feats, masks), labels, train)

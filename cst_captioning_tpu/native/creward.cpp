@@ -6,6 +6,15 @@
 // 80% of the SCST step. This kernel does the same arithmetic over interned
 // token ids with FNV-style 64-bit gram hashes, multi-threaded, GIL-free.
 //
+// Hot-path layout (r4): reference pools are flattened ONCE at add_video time
+// into hash-sorted flat arrays; each hypothesis row builds its (deduped,
+// sorted) gram lists in per-worker reusable buffers and every dot product /
+// clipped match is a two-pointer merge-join — zero hash-map construction or
+// lookup per row outside the shared read-only df table. On captions (≤ ~30
+// tokens) this is ~3x the throughput of the original per-row unordered_map
+// implementation, which matters because the reward competes with dispatch
+// for the host core that the pipelined epoch hides it under.
+//
 // Semantics are EXACTLY the Python oracles (cst_captioning_tpu.metrics):
 //   - CIDEr-D: tf-idf n-gram cosine with hyp counts clipped to the ref's,
 //     gaussian length penalty exp(-(lh-lr)^2 / (2*sigma^2)), mean over
@@ -20,6 +29,7 @@
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 -pthread creward.cpp -o libcreward.so
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -46,8 +56,7 @@ inline uint64_t hash_gram(const int32_t* toks, int n) {
 
 using GramCounts = std::unordered_map<uint64_t, int>;
 
-// n-gram counts of one token sequence, all orders 1..4 in one map
-// (hash already encodes the order).
+// n-gram counts of one token sequence, all orders 1..4 (build-time only).
 void count_grams(const int32_t* toks, int len, GramCounts out[MAX_N]) {
     for (int n = 1; n <= MAX_N; ++n) {
         GramCounts& m = out[n - 1];
@@ -57,16 +66,27 @@ void count_grams(const int32_t* toks, int len, GramCounts out[MAX_N]) {
     }
 }
 
+// hash-sorted flat (gram -> weight) vector: the merge-join operand
+struct FlatVec {
+    std::vector<uint64_t> h;
+    std::vector<double> w;
+};
+
+// hash-sorted flat (gram -> count) vector
+struct FlatCounts {
+    std::vector<uint64_t> h;
+    std::vector<int> c;
+};
+
 struct RefVec {
-    // tf-idf vector per order: gram hash -> weight
-    std::unordered_map<uint64_t, double> vec[MAX_N];
+    FlatVec vec[MAX_N];
     double norm[MAX_N] = {0, 0, 0, 0};
     int len = 0;
 };
 
 struct VideoStats {
     std::vector<RefVec> cider;            // per reference
-    GramCounts bleu_max[MAX_N];           // elementwise max ref counts
+    FlatCounts bleu_max[MAX_N];           // elementwise max ref counts
     std::vector<int> ref_lens;
 };
 
@@ -96,20 +116,65 @@ int effective_row(const int32_t* row, int T, const Ctx& c, int32_t* out) {
     return n;
 }
 
-double cider_d_one(const Ctx& c, const VideoStats& vs, const GramCounts counts[MAX_N],
-                   int hyp_len) {
-    // hypothesis tf-idf vectors
-    std::unordered_map<uint64_t, double> hvec[MAX_N];
-    double hnorm[MAX_N] = {0, 0, 0, 0};
-    for (int n = 0; n < MAX_N; ++n) {
-        hvec[n].reserve(counts[n].size() * 2);
-        for (const auto& kv : counts[n]) {
-            double w = (double)kv.second * idf(c, kv.first);
-            hvec[n][kv.first] = w;
-            hnorm[n] += w * w;
+// one hypothesis's grams of one order: deduped, then hash-sorted, with
+// per-gram tf-idf weights. Buffers are reused across rows (no allocation in
+// the steady state — capacity grows to the max gram count once).
+struct HypOrder {
+    std::vector<uint64_t> h;
+    std::vector<int> c;
+    std::vector<double> w;     // tf * idf, filled after sorting
+    std::vector<int> order;    // sort permutation scratch
+    std::vector<uint64_t> h2;  // permutation-apply scratch
+    std::vector<int> c2;
+
+    void build(const int32_t* toks, int len, int n) {
+        h.clear();
+        c.clear();
+        for (int i = 0; i + n <= len; ++i) {
+            uint64_t hh = hash_gram(toks + i, n);
+            // linear dedup: caption-order gram counts are tiny (<= ~30)
+            size_t j = 0, sz = h.size();
+            for (; j < sz; ++j) {
+                if (h[j] == hh) {
+                    ++c[j];
+                    break;
+                }
+            }
+            if (j == sz) {
+                h.push_back(hh);
+                c.push_back(1);
+            }
         }
-        hnorm[n] = std::sqrt(hnorm[n]);
+        // sort (h, c) by hash via a permutation (arrays are tiny)
+        size_t sz = h.size();
+        order.resize(sz);
+        for (size_t i = 0; i < sz; ++i) order[i] = (int)i;
+        std::sort(order.begin(), order.end(),
+                  [&](int a, int b) { return h[a] < h[b]; });
+        w.resize(sz);
+        h2.resize(sz);
+        c2.resize(sz);
+        for (size_t i = 0; i < sz; ++i) {
+            h2[i] = h[order[i]];
+            c2[i] = c[order[i]];
+        }
+        h.swap(h2);
+        c.swap(c2);
     }
+
+    double weigh(const Ctx& ctx) {   // fills w, returns l2 norm
+        double norm = 0.0;
+        for (size_t i = 0; i < h.size(); ++i) {
+            double ww = (double)c[i] * idf(ctx, h[i]);
+            w[i] = ww;
+            norm += ww * ww;
+        }
+        return std::sqrt(norm);
+    }
+};
+
+double cider_d_one(const Ctx& c, const VideoStats& vs, HypOrder hyp[MAX_N],
+                   const double hnorm[MAX_N], int hyp_len) {
     double per_n[MAX_N] = {0, 0, 0, 0};
     for (const RefVec& rv : vs.cider) {
         double pen = std::exp(-((double)(hyp_len - rv.len) * (hyp_len - rv.len)) /
@@ -117,12 +182,21 @@ double cider_d_one(const Ctx& c, const VideoStats& vs, const GramCounts counts[M
         for (int n = 0; n < MAX_N; ++n) {
             double denom = hnorm[n] * rv.norm[n];
             if (denom <= 0) continue;
+            const HypOrder& ho = hyp[n];
+            const FlatVec& fv = rv.vec[n];
             double dot = 0.0;
-            for (const auto& kv : hvec[n]) {
-                auto it = rv.vec[n].find(kv.first);
-                if (it != rv.vec[n].end()) {
-                    double hw = kv.second, rw = it->second;
+            size_t i = 0, j = 0, hs = ho.h.size(), rs = fv.h.size();
+            while (i < hs && j < rs) {          // sorted merge-join
+                uint64_t a = ho.h[i], b = fv.h[j];
+                if (a == b) {
+                    double hw = ho.w[i], rw = fv.w[j];
                     dot += (hw < rw ? hw : rw) * rw;
+                    ++i;
+                    ++j;
+                } else if (a < b) {
+                    ++i;
+                } else {
+                    ++j;
                 }
             }
             per_n[n] += pen * dot / denom;
@@ -134,7 +208,7 @@ double cider_d_one(const Ctx& c, const VideoStats& vs, const GramCounts counts[M
     return mean / MAX_N * 10.0;
 }
 
-double bleu4_one(const Ctx& c, const VideoStats& vs, const GramCounts counts[MAX_N],
+double bleu4_one(const Ctx&, const VideoStats& vs, const HypOrder hyp[MAX_N],
                  int hyp_len) {
     if (hyp_len == 0 || vs.ref_lens.empty()) return 0.0;
     // closest ref length (ties -> smaller)
@@ -147,12 +221,21 @@ double bleu4_one(const Ctx& c, const VideoStats& vs, const GramCounts counts[MAX
     double log_p = 0.0, score = 0.0;
     for (int n = 1; n <= MAX_N; ++n) {
         long matched = 0, total = 0;
-        const GramCounts& maxc = vs.bleu_max[n - 1];
-        for (const auto& kv : counts[n - 1]) {
-            total += kv.second;
-            auto it = maxc.find(kv.first);
-            if (it != maxc.end())
-                matched += kv.second < it->second ? kv.second : it->second;
+        const HypOrder& ho = hyp[n - 1];
+        const FlatCounts& maxc = vs.bleu_max[n - 1];
+        size_t i = 0, j = 0, hs = ho.h.size(), rs = maxc.h.size();
+        for (size_t k = 0; k < hs; ++k) total += ho.c[k];
+        while (i < hs && j < rs) {              // sorted merge-join
+            uint64_t a = ho.h[i], b = maxc.h[j];
+            if (a == b) {
+                matched += ho.c[i] < maxc.c[j] ? ho.c[i] : maxc.c[j];
+                ++i;
+                ++j;
+            } else if (a < b) {
+                ++i;
+            } else {
+                ++j;
+            }
         }
         double p;
         if (n == 1) p = total ? (double)matched / total : 0.0;
@@ -162,6 +245,23 @@ double bleu4_one(const Ctx& c, const VideoStats& vs, const GramCounts counts[MAX
         score = bp * std::exp(log_p / n);
     }
     return score;
+}
+
+// flatten an unordered map into a hash-sorted FlatVec/FlatCounts
+void flatten_vec(const std::unordered_map<uint64_t, double>& m, FlatVec& out) {
+    out.h.reserve(m.size());
+    for (const auto& kv : m) out.h.push_back(kv.first);
+    std::sort(out.h.begin(), out.h.end());
+    out.w.resize(out.h.size());
+    for (size_t i = 0; i < out.h.size(); ++i) out.w[i] = m.at(out.h[i]);
+}
+
+void flatten_counts(const GramCounts& m, FlatCounts& out) {
+    out.h.reserve(m.size());
+    for (const auto& kv : m) out.h.push_back(kv.first);
+    std::sort(out.h.begin(), out.h.end());
+    out.c.resize(out.h.size());
+    for (size_t i = 0; i < out.h.size(); ++i) out.c[i] = m.at(out.h[i]);
 }
 
 }  // namespace
@@ -202,32 +302,38 @@ int32_t crw_add_video(void* h, const int32_t* tokens, const int32_t* ref_lens,
     Ctx* c = (Ctx*)h;
     c->videos.emplace_back();
     VideoStats& vs = c->videos.back();
+    GramCounts bleu_max[MAX_N];
     int64_t off = 0;
     for (int32_t r = 0; r < n_refs; ++r) {
         int len = ref_lens[r];
         GramCounts counts[MAX_N];
         count_grams(tokens + off, len, counts);
-        // CIDEr vectors
+        // CIDEr vectors (built in a map, flattened hash-sorted for the
+        // per-row merge-joins)
         vs.cider.emplace_back();
         RefVec& rv = vs.cider.back();
         rv.len = len;
         for (int n = 0; n < MAX_N; ++n) {
+            std::unordered_map<uint64_t, double> vec;
+            vec.reserve(counts[n].size() * 2);
             for (const auto& kv : counts[n]) {
                 double w = (double)kv.second * idf(*c, kv.first);
-                rv.vec[n][kv.first] = w;
+                vec[kv.first] = w;
                 rv.norm[n] += w * w;
             }
             rv.norm[n] = std::sqrt(rv.norm[n]);
+            flatten_vec(vec, rv.vec[n]);
         }
         // BLEU max counts
         for (int n = 0; n < MAX_N; ++n)
             for (const auto& kv : counts[n]) {
-                int& slot = vs.bleu_max[n][kv.first];
+                int& slot = bleu_max[n][kv.first];
                 if (kv.second > slot) slot = kv.second;
             }
         vs.ref_lens.push_back(len);
         off += len;
     }
+    for (int n = 0; n < MAX_N; ++n) flatten_counts(bleu_max[n], vs.bleu_max[n]);
     return (int32_t)(c->videos.size() - 1);
 }
 
@@ -240,14 +346,20 @@ void crw_score(void* h, const int32_t* video_idx, const int32_t* rows,
     if (n_threads < 1) n_threads = 1;
     auto worker = [&](int64_t lo, int64_t hi) {
         std::vector<int32_t> buf(T);
+        HypOrder hyp[MAX_N];   // reused across rows: no steady-state mallocs
         for (int64_t i = lo; i < hi; ++i) {
             const VideoStats& vs = c->videos[video_idx[i]];
             int len = effective_row(rows + i * T, T, *c, buf.data());
-            GramCounts counts[MAX_N];
-            count_grams(buf.data(), len, counts);
+            double hnorm[MAX_N] = {0, 0, 0, 0};
+            for (int n = 0; n < MAX_N; ++n) {
+                hyp[n].build(buf.data(), len, n + 1);
+                if (cider_w != 0.0) hnorm[n] = hyp[n].weigh(*c);  // df lookups
+            }
             double r = 0.0;
-            if (cider_w != 0.0) r += cider_w * cider_d_one(*c, vs, counts, len);
-            if (bleu_w != 0.0) r += bleu_w * bleu4_one(*c, vs, counts, len) * 10.0;
+            if (cider_w != 0.0)
+                r += cider_w * cider_d_one(*c, vs, hyp, hnorm, len);
+            if (bleu_w != 0.0)
+                r += bleu_w * bleu4_one(*c, vs, hyp, len) * 10.0;
             out[i] = (float)r;
         }
     };

@@ -32,6 +32,7 @@ from cst_captioning_tpu.config.config import RLConfig
 from cst_captioning_tpu.decoding import greedy_decode, sample_decode
 from cst_captioning_tpu.decoding.common import mask_from_tokens
 from cst_captioning_tpu.losses import reinforce_loss, sequence_log_probs
+from cst_captioning_tpu.models.captioner import CaptionModel
 from cst_captioning_tpu.rl.rewards import RewardComputer, scb_baseline
 from cst_captioning_tpu.train.state import TrainState
 
@@ -116,6 +117,35 @@ def _tile_feats(feats, masks, K):
     )
 
 
+def _tile_enc(enc, K):
+    """EncoderOutput [B, ...] -> [K*B, ...] (rollout-major, see _tile_feats).
+
+    Tiling the ENCODED memory instead of the raw features lets the update
+    run the encoder once per clip instead of once per rollout row — the
+    encoder is ~12% of the update FLOPs at the flagship dims, and gradients
+    flow through the tile as a sum over the K copies (same math as the
+    feature-tiled computation up to float summation order)."""
+    t = lambda x: jnp.tile(x, (K,) + (1,) * (x.ndim - 1))
+    return jax.tree.map(t, enc)
+
+
+def _decode_loss_sums(model, params, enc_tiled, tokens_flat, advantage_flat,
+                      valid_tiled):
+    """(numerator, denominator) REINFORCE sums from tiled encoder output.
+
+    Uses the in-scan ``teacher_force_logps`` path: the full [rows, T, V]
+    logits stack (~2 GB f32 at the flagship dims) is never materialized —
+    each step's logits are reduced to the target-token logprob in place."""
+
+    logp = model.apply(
+        params, enc_tiled, tokens_flat, method=CaptionModel.teacher_force_logps
+    )
+    mask = mask_from_tokens(tokens_flat) * valid_tiled[:, None]
+    den = jnp.sum(mask)
+    num = reinforce_loss(logp, mask, advantage_flat) * jnp.maximum(den, 1.0)
+    return num, den
+
+
 def accumulate_chunk_grads(sums_fn, params, xs, vary_axis: str | None = None):
     """``lax.scan`` of ``value_and_grad(sums_fn)`` over leading-axis chunks.
 
@@ -124,8 +154,13 @@ def accumulate_chunk_grads(sums_fn, params, xs, vary_axis: str | None = None):
     accumulated, and the caller divides once by the total denominator (which
     is parameter-independent). The total gradient therefore equals the fused
     computation up to float summation order while only one chunk's
-    activations are ever live — the shared engine of ``rl.update_chunks``
-    (used here and by parallel/seq_parallel.py's SP update).
+    activations are ever live.
+
+    Used by parallel/seq_parallel.py's SP update; the DP paths in this
+    module use :func:`_chunked_loss_grads`, which extends the same
+    scan-accumulate + pcast(vary_axis) pattern with encoder-output
+    cotangents — a fix to the varying-carry handling here almost certainly
+    applies there too (and vice versa).
 
     Returns ``(num_total, den_total, grad_sums)``.
     """
@@ -153,25 +188,83 @@ def accumulate_chunk_grads(sums_fn, params, xs, vary_axis: str | None = None):
 def _chunked_loss_grads(model, params, feats, masks, samples, advantage,
                         valid, chunks: int, vary_axis: str | None = None):
     """REINFORCE loss sums + gradients, accumulated over ``chunks`` slices
-    of the K rollout axis.
+    of the K rollout axis — with ONE encoder pass shared by every chunk.
 
     Teacher-forcing all K*B sequences at once is the HBM ceiling on batch
     size (VERDICT r2 weak #1); chunking bounds the live activation footprint
-    to K/chunks rollouts — see :func:`accumulate_chunk_grads`.
+    to K/chunks rollouts. The encoder runs once on the B clip rows
+    (``jax.vjp`` keeps its backward); each scanned chunk differentiates the
+    decode w.r.t. (params, encoder output), the encoder-output cotangents
+    accumulate in f32 across chunks, and one ``enc_vjp`` call at the end
+    folds them into the parameter gradients. Same total gradient as the
+    feature-tiled computation up to float summation order.
     """
+
     K, B, T = samples.shape
     if K % chunks:
         raise ValueError(f"update_chunks {chunks} must divide K={K} rollouts")
     kc = K // chunks
-    feats_f, masks_f = _tile_feats(feats, masks, kc)
+
+    def enc_fn(p):
+        e = model.apply(p, feats, masks, method=CaptionModel.encode)
+        if vary_axis is not None:
+            # inside shard_map, outputs that don't depend on the sharded
+            # inputs (e.g. the meanpool encoder's all-ones memory_mask) are
+            # device-INVARIANT, and the vjp would then reject the varying
+            # per-shard cotangents accumulated below. Adding a varying zero
+            # to every leaf makes the whole output uniformly varying; its
+            # transpose lands in the (discarded) feats cotangent, so the
+            # parameter gradients are untouched.
+            zv = jnp.sum(jax.tree.leaves(feats)[0]) * 0.0
+            e = jax.tree.map(lambda x: x + zv.astype(x.dtype), e)
+        return e
+
+    enc, enc_vjp = jax.vjp(enc_fn, params)
     valid_f = jnp.tile(valid, (kc,))
     sam = samples.reshape(chunks, kc * B, T)
     adv = advantage.reshape(chunks, kc * B)
 
-    def sums_fn(p, tokens, a):
-        return _rl_loss_sums(model, p, feats_f, masks_f, tokens, a, valid_f)
+    def sums_fn(p, e, tokens, a):
+        return _decode_loss_sums(
+            model, p, _tile_enc(e, kc), tokens, a, valid_f
+        )
 
-    return accumulate_chunk_grads(sums_fn, params, (sam, adv), vary_axis)
+    def body(acc, x):
+        gp_acc, ge_acc, num_acc, den_acc = acc
+        (num, den), (gp, ge) = jax.value_and_grad(
+            sums_fn, argnums=(0, 1), has_aux=True
+        )(params, enc, *x)
+        return (
+            jax.tree.map(jnp.add, gp_acc, gp),
+            # f32 accumulation: the cotangents arrive in the model dtype
+            # (bf16 on the flagship config) and 8 mantissa bits across
+            # `chunks` additions is avoidable error
+            jax.tree.map(lambda a_, g: a_ + g.astype(a_.dtype), ge_acc, ge),
+            num_acc + num,
+            den_acc + den,
+        ), None
+
+    init = (
+        jax.tree.map(jnp.zeros_like, params),
+        jax.tree.map(
+            lambda x: jnp.zeros(x.shape, jnp.promote_types(x.dtype, jnp.float32)),
+            enc,
+        ),
+        jnp.zeros(()),
+        jnp.zeros(()),
+    )
+    if vary_axis is not None:
+        # inside shard_map the per-chunk grads/sums vary over the batch
+        # axis; the scan carry init must carry the same varying-axis type
+        init = jax.tree.map(
+            lambda x: jax.lax.pcast(x, vary_axis, to="varying"), init
+        )
+    (gp, ge, num, den), _ = jax.lax.scan(body, init, (sam, adv))
+    # vjp cotangents must match the primal dtype
+    ge = jax.tree.map(lambda g, x: g.astype(x.dtype), ge, enc)
+    (g_enc,) = enc_vjp(ge)
+    g_sum = jax.tree.map(jnp.add, gp, g_enc)
+    return num, den, g_sum
 
 
 def make_rl_update(model, chunks: int = 1) -> Callable:
@@ -193,15 +286,17 @@ def make_rl_update(model, chunks: int = 1) -> Callable:
             loss = num / den
             grads = jax.tree.map(lambda g: g / den, g_sum)
         else:
+
             K, B, T = samples.shape
-            feats_f, masks_f = _tile_feats(feats, masks, K)
             tokens = samples.reshape(K * B, T)
             adv = advantage.reshape(K * B)
             valid_f = jnp.tile(valid, (K,))
 
             def loss_fn(p):
-                num, den = _rl_loss_sums(
-                    model, p, feats_f, masks_f, tokens, adv, valid_f
+                # one encoder pass per clip; memory tiled over rollouts
+                enc = model.apply(p, feats, masks, method=CaptionModel.encode)
+                num, den = _decode_loss_sums(
+                    model, p, _tile_enc(enc, K), tokens, adv, valid_f
                 )
                 return num / jnp.maximum(den, 1.0)
 
@@ -226,15 +321,16 @@ def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
                 chunks, vary_axis=axis,
             )
         else:
+
             K, Bl, T = samples.shape
-            feats_f, masks_f = _tile_feats(feats, masks, K)
             tokens = samples.reshape(K * Bl, T)
             adv = advantage.reshape(K * Bl)
             valid_f = jnp.tile(valid, (K,))
 
             def local_num(p):
-                return _rl_loss_sums(
-                    model, p, feats_f, masks_f, tokens, adv, valid_f
+                enc = model.apply(p, feats, masks, method=CaptionModel.encode)
+                return _decode_loss_sums(
+                    model, p, _tile_enc(enc, K), tokens, adv, valid_f
                 )
 
             (num, den), grads_num = jax.value_and_grad(

@@ -189,3 +189,24 @@ def test_sequence_log_probs_gather():
     logits = jnp.log(jnp.asarray([[[0.1, 0.2, 0.7]]], jnp.float32))
     lp = sequence_log_probs(logits, jnp.asarray([[2]], jnp.int32))
     np.testing.assert_allclose(lp, np.log(0.7), rtol=1e-4)
+
+
+@pytest.mark.parametrize("encoder", ["temporal_attention", "meanpool"])
+def test_teacher_force_logps_matches_full_logits(encoder):
+    """The in-scan target-logp path (the RL update's memory-lean form) must
+    equal gather(log_softmax(decode_logits)) exactly — same math, the [B,T,V]
+    stack just never materializes."""
+    cfg = tiny_cfg(encoder=encoder)
+    model = CaptionModel(cfg)
+    feats, masks, labels = make_batch(3)
+    params = model.init(jax.random.key(0), feats, masks, labels)
+    enc = model.apply(params, feats, masks, method=CaptionModel.encode)
+    full = sequence_log_probs(
+        model.apply(params, enc, labels, method=CaptionModel.decode_logits),
+        labels,
+    )
+    lean = model.apply(
+        params, enc, labels, method=CaptionModel.teacher_force_logps
+    )
+    np.testing.assert_allclose(np.asarray(lean), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)
