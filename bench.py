@@ -50,11 +50,12 @@ TARGET_MULTIPLIER = 3.0
 # The fused update teacher-forces K*B sequences at once, capping the batch at
 # B=512 on a 16G v5e chip (B=1024 fused: "Used 18.84G of 15.75G hbm");
 # update_chunks=5 accumulates gradients per rollout, lifting the ceiling.
-# Round-3 sweep on TPU v5e (chunks=5, pipelined): 1024->2074, 1536->2368,
-# 1792->2406, 2048->220 (past the knee pre-overlap). With the async
-# device->host token transfer overlap (scst.train_epoch): 1792->~2900-2970,
-# 2048->2813. Fused round-2 sweep for reference: 64->260, 128->525,
-# 256->865, 512->1341.
+# Round-4 sweep (chunks=5, in-scan logp update + merge-join scorer):
+# 1536->3827, 1792->3930-3975, 2048->3879, 2560->3832 — a flat plateau with
+# 1792 on top; the round-3 B=2048 cliff (2800) is gone now that the host is
+# off the critical path. Earlier history: round-3 (pre-optimization)
+# 1024->2074, 1536->2368, 1792->2406->~2900-2970 with async transfer;
+# round-2 fused 64->260, 128->525, 256->865, 512->1341.
 BATCH = 1792
 DEFAULT_CHUNKS = 5
 FRAMES = 20
@@ -202,6 +203,66 @@ def _bench_xe(args, model, state, feats, masks, labels) -> None:
     }))
 
 
+def _bench_eval(args, model, state, feats, masks) -> None:
+    """Eval-phase throughput: beam-5 decode (BASELINE config 5) on the
+    flagship model — clips/s/chip of the test-time path. The default RL
+    batch is far past the beam path's memory knee (beam search keeps
+    beam_size copies of the decode state per clip); pass --batch to sweep."""
+    import jax
+
+    from cst_captioning_tpu.decoding import beam_search
+
+    import jax.numpy as jnp
+
+    batch_size, measure_steps = args.batch, args.steps
+    n_chips = len(jax.devices())
+
+    # each rep decodes PERTURBED features and feeds a token checksum forward:
+    # repeated identical pure dispatches are memoized by the axon tunnel
+    # (6.6e6 "clips/s" observed), and block_until_ready alone can return
+    # before real completion — only the final host readback of the chained
+    # checksum is trustworthy (see .claude/skills/verify gotchas)
+    @jax.jit
+    def step(p, f, m, i, acc):
+        f = {k: v + (i * 1e-6).astype(v.dtype) for k, v in f.items()}
+        tokens = beam_search(model, p, f, m, beam_size=5, max_len=MAX_LEN)[0]
+        return acc + jnp.sum(tokens.astype(jnp.float32))
+
+    t0 = time.perf_counter()
+    acc = step(state.params, feats, masks, jnp.float32(0), jnp.float32(0))
+    float(np.asarray(acc))
+    print(f"bench: eval compile+first batch {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+    t0 = time.perf_counter()
+    acc = jnp.float32(0)
+    for i in range(measure_steps):
+        acc = step(state.params, feats, masks, jnp.float32(i + 1), acc)
+    float(np.asarray(acc))  # one readback forcing the whole chain
+    dt = time.perf_counter() - t0
+    if args.profile:
+        jax.profiler.stop_trace()
+
+    per_chip = batch_size * measure_steps / dt / max(n_chips, 1)
+    kind = jax.devices()[0].device_kind
+    print(
+        f"bench: eval {measure_steps} batches in {dt:.2f}s -> {per_chip:.1f} "
+        f"clips/s/chip (beam=5, B={batch_size}, T={MAX_LEN})",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "eval_beam5_clips_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "clips/s/chip",
+        "batch": batch_size,
+        "beam_size": 5,
+        "max_len": MAX_LEN,
+        "device_kind": kind,
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--profile", default="", metavar="DIR",
@@ -211,11 +272,19 @@ def main() -> None:
     ap.add_argument("--chunks", type=int, default=DEFAULT_CHUNKS,
                     help="rl.update_chunks (divides K=5; 1 = fused — the "
                          "fused update OOMs above --batch 512 on a 16G chip)")
-    ap.add_argument("--phase", choices=("rl", "xe"), default="rl",
-                    help="rl (default, the north-star metric) or xe: "
-                         "teacher-forced cross-entropy step throughput on "
-                         "the same flagship model")
+    ap.add_argument("--phase", choices=("rl", "xe", "eval"), default="rl",
+                    help="rl (default, the north-star metric); xe: "
+                         "teacher-forced cross-entropy step throughput; "
+                         "eval: beam-5 decode throughput — all on the same "
+                         "flagship model")
     args = ap.parse_args()
+    if args.phase == "eval" and args.batch == BATCH:
+        # the RL default batch is far past the beam path's memory knee
+        # (beam search keeps beam_size copies of the decode state per
+        # clip) — default eval to BASELINE.md's documented operating point
+        args.batch = 256
+        print("bench: eval defaulting to --batch 256 (the RL default 1792 "
+              "is past the beam-path knee)", file=sys.stderr)
     batch_size, measure_steps = args.batch, args.steps
     if args.phase == "rl" and args.chunks == 1 and batch_size > 512:
         # fail before the multi-minute warmup compile, not after it
@@ -263,6 +332,9 @@ def main() -> None:
 
     if args.phase == "xe":
         _bench_xe(args, model, state, feats, masks, labels)
+        return
+    if args.phase == "eval":
+        _bench_eval(args, model, state, feats, masks)
         return
 
     # synthetic consensus pools: 5 GT captions per video over a real vocab
