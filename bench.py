@@ -135,13 +135,14 @@ def _analytic_flops_per_clip(
     input-feed LSTM (in = word d + ctx d), and the d->V output projection.
     Decode runs the encoder once each for the greedy and sampling programs
     (sample_decode shares one encode across rollouts) and steps 1+K rows per
-    clip; the update teacher-forces K TILED copies (encoder included, see
-    scst._tile_feats) with a backward pass (~2x forward). Elementwise /
-    softmax work is ignored (matmul-dominated).
+    clip; the update encodes each clip ONCE and tiles the encoded memory
+    over the K teacher-forced rollout copies (scst._tile_enc), with a
+    backward pass (~2x forward). Elementwise / softmax work is ignored
+    (matmul-dominated).
     """
     enc, per_tok = _enc_and_per_tok_flops(F, d, d_att, V, feat_dims)
     decode = 2 * enc + (1 + K) * T * per_tok
-    update = 3 * K * (enc + T * per_tok)
+    update = 3 * (enc + K * T * per_tok)
     return float(decode + update)
 
 

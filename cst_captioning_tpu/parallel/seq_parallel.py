@@ -205,13 +205,21 @@ def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
     def sharded_sums(params, feats, masks, samples, advantage, valid):
         # the single source of truth for tiling + REINFORCE loss sums lives
         # in rl/scst.py (import here: scst's own parallel import is lazy, so
-        # there is no module-level cycle)
-        from cst_captioning_tpu.rl.scst import _rl_loss_sums, _tile_feats
+        # there is no module-level cycle). Same shape as the DP update:
+        # encode the clip rows, tile the ENCODED memory over rollouts, and
+        # compute target logps inside the teacher-forcing scan — the
+        # [K*Bl, T, V] logits stack never materializes, which matters most
+        # here (long-context SP exists because memory is tight). With
+        # chunks>1 this function runs once per chunk, so the encode is
+        # repeated per chunk at the jaxpr level (XLA's loop-invariant
+        # hoisting dedups it in practice; the DP path's _chunked_loss_grads
+        # makes the sharing explicit via jax.vjp instead)
+        from cst_captioning_tpu.rl.scst import _decode_loss_sums, _tile_enc
 
         K, Bl, T = samples.shape
-        feats_f, masks_f = _tile_feats(feats, masks, K)
-        num, den = _rl_loss_sums(
-            model, params, feats_f, masks_f,
+        enc = model.apply(params, feats, masks, method=CaptionModel.encode)
+        num, den = _decode_loss_sums(
+            model, params, _tile_enc(enc, K),
             samples.reshape(K * Bl, T),
             advantage.reshape(K * Bl),
             jnp.tile(valid, (K,)),

@@ -93,32 +93,9 @@ def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
     return jax.jit(sharded)
 
 
-def _rl_loss_sums(model, params, feats, masks, tokens_flat, advantage_flat,
-                  valid_flat):
-    """(numerator, denominator) of REINFORCE loss over flattened rollouts.
-
-    ``valid_flat`` zeroes wrap-padded duplicate rows from short final batches
-    so they carry no gradient weight and don't dilute the normalization.
-    """
-    logits = model.apply(params, feats, masks, tokens_flat)
-    logp = sequence_log_probs(logits, tokens_flat)
-    mask = mask_from_tokens(tokens_flat) * valid_flat[:, None]
-    den = jnp.sum(mask)
-    num = reinforce_loss(logp, mask, advantage_flat) * jnp.maximum(den, 1.0)
-    return num, den
-
-
-def _tile_feats(feats, masks, K):
-    """[B, ...] -> [K*B, ...] (rollout-major tiling to match samples.reshape)."""
-    t = lambda x: jnp.tile(x, (K,) + (1,) * (x.ndim - 1))
-    return (
-        {k: t(v) for k, v in feats.items()},
-        {k: t(v) for k, v in masks.items()},
-    )
-
-
 def _tile_enc(enc, K):
-    """EncoderOutput [B, ...] -> [K*B, ...] (rollout-major, see _tile_feats).
+    """EncoderOutput [B, ...] -> [K*B, ...] (rollout-major tiling to match
+    ``samples.reshape``).
 
     Tiling the ENCODED memory instead of the raw features lets the update
     run the encoder once per clip instead of once per rollout row — the
@@ -133,9 +110,12 @@ def _decode_loss_sums(model, params, enc_tiled, tokens_flat, advantage_flat,
                       valid_tiled):
     """(numerator, denominator) REINFORCE sums from tiled encoder output.
 
-    Uses the in-scan ``teacher_force_logps`` path: the full [rows, T, V]
-    logits stack (~2 GB f32 at the flagship dims) is never materialized —
-    each step's logits are reduced to the target-token logprob in place."""
+    ``valid_tiled`` zeroes wrap-padded duplicate rows from short final
+    batches so they carry no gradient weight and don't dilute the
+    normalization. Uses the in-scan ``teacher_force_logps`` path: the full
+    [rows, T, V] logits stack (~2 GB f32 at the flagship dims) is never
+    materialized — each step's logits are reduced to the target-token
+    logprob in place."""
 
     logp = model.apply(
         params, enc_tiled, tokens_flat, method=CaptionModel.teacher_force_logps
