@@ -82,22 +82,31 @@ def make_sp_forward(model: CaptionModel, mesh: Mesh, data_axis: str = "",
 
 def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
                    temperature: float = 1.0, max_len: int | None = None,
-                   seq_axis: str = "seq", data_axis: str = "") -> Callable:
+                   seq_axis: str = "seq", data_axis: str = "",
+                   with_greedy: bool = True) -> Callable:
     """Jitted SP decode: (params, feats, masks, rng) -> (greedy, samples|None).
 
     The long-video RL/eval decode: frames sharded over ``seq_axis``; the
     batch replicates, or shards over ``data_axis`` when given (DP x SP —
     the product layout for ``MeshConfig.seq_devices > 1``). With
-    ``num_rollouts=0`` only the greedy decode runs (eval path).
+    ``num_rollouts=0`` only the greedy decode runs (eval path);
+    ``with_greedy=False`` skips the greedy rollout (greedy is None — the
+    scb/none baselines never consume it, see make_rl_decode).
     """
     f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
     b = data_axis if data_axis else None
+    if not num_rollouts and not with_greedy:
+        raise ValueError("nothing to decode: num_rollouts=0 and no greedy")
 
     def dec(params, feats, masks, rng):
         if data_axis:
             # independent sampling streams per batch shard
             rng = jax.random.fold_in(rng, jax.lax.axis_index(data_axis))
-        greedy, _ = greedy_decode(model, params, feats, masks, max_len=max_len)
+        greedy = None
+        if with_greedy:
+            greedy, _ = greedy_decode(
+                model, params, feats, masks, max_len=max_len
+            )
         if num_rollouts:
             samples, _ = sample_decode(
                 model, params, feats, masks, rng,

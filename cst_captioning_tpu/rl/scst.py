@@ -38,12 +38,21 @@ from cst_captioning_tpu.train.state import TrainState
 
 
 def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
-                   max_len: int | None = None) -> Callable:
-    """Jitted: (params, feats, masks, rng) -> (greedy [B,T], samples [K,B,T])."""
+                   max_len: int | None = None,
+                   with_greedy: bool = True) -> Callable:
+    """Jitted: (params, feats, masks, rng) -> (greedy [B,T], samples [K,B,T]).
+
+    ``with_greedy=False`` skips the greedy rollout (``greedy`` is None):
+    only the 'greedy' baseline consumes it, so the scb/none baselines save
+    one of the K+1 decoded rows per clip plus its host transfer + reward."""
 
     @jax.jit
     def decode(params, feats, masks, rng):
-        greedy, _ = greedy_decode(model, params, feats, masks, max_len=max_len)
+        greedy = None
+        if with_greedy:
+            greedy, _ = greedy_decode(
+                model, params, feats, masks, max_len=max_len
+            )
         samples, _ = sample_decode(
             model, params, feats, masks, rng,
             num_rollouts=num_rollouts, temperature=temperature, max_len=max_len,
@@ -56,7 +65,8 @@ def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
 def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
                             temperature: float = 1.0,
                             max_len: int | None = None,
-                            axis: str = "data") -> Callable:
+                            axis: str = "data",
+                            with_greedy: bool = True) -> Callable:
     """shard_map decode: batch sharded over the mesh, the dominant RL cost
     scales with chips (SURVEY.md §3.2/§7 step 6) instead of running on one.
 
@@ -69,7 +79,11 @@ def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
 
     def device_decode(params, feats, masks, rng):
         local_rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
-        greedy, _ = greedy_decode(model, params, feats, masks, max_len=max_len)
+        greedy = None
+        if with_greedy:
+            greedy, _ = greedy_decode(
+                model, params, feats, masks, max_len=max_len
+            )
         samples, _ = sample_decode(
             model, params, feats, masks, local_rng,
             num_rollouts=num_rollouts, temperature=temperature, max_len=max_len,
@@ -361,6 +375,10 @@ class SCSTTrainer:
         self.reward = reward
         self.cfg = cfg
         self.mesh = mesh
+        # only the 'greedy' baseline consumes the greedy rollout: scb/none
+        # skip its decode, host transfer, and reward scoring entirely (one
+        # of the K+1 decoded rows per clip on the flagship config)
+        wg = cfg.baseline == "greedy"
         if mesh is not None and "seq" in mesh.axis_names:
             # DP x SP (MeshConfig.seq_devices > 1): frames shard over 'seq'
             # with the collective attention softmax, batch over 'data'
@@ -371,19 +389,21 @@ class SCSTTrainer:
             spm = model if model.cfg.seq_axis else sp_model(model.cfg)
             self.decode = make_sp_decode(
                 spm, mesh, cfg.num_rollouts, cfg.temperature, max_len,
-                data_axis="data",
+                data_axis="data", with_greedy=wg,
             )
             self.update = make_sp_rl_update(spm, mesh, chunks=cfg.update_chunks)
         elif mesh is not None:
             self.decode = make_parallel_rl_decode(
-                model, mesh, cfg.num_rollouts, cfg.temperature, max_len
+                model, mesh, cfg.num_rollouts, cfg.temperature, max_len,
+                with_greedy=wg,
             )
             self.update = make_parallel_rl_update(
                 model, mesh, chunks=cfg.update_chunks
             )
         else:
             self.decode = make_rl_decode(
-                model, cfg.num_rollouts, cfg.temperature, max_len
+                model, cfg.num_rollouts, cfg.temperature, max_len,
+                with_greedy=wg,
             )
             self.update = make_rl_update(model, chunks=cfg.update_chunks)
 
@@ -397,6 +417,11 @@ class SCSTTrainer:
         r_kb = r_samples.reshape(K, B)
 
         if self.cfg.baseline == "greedy":
+            if greedy is None:
+                raise ValueError(
+                    "baseline='greedy' needs the greedy rollout; the decode "
+                    "was built with with_greedy=False"
+                )
             r_greedy = self.reward(video_ids, np.asarray(greedy))
             baseline = np.broadcast_to(r_greedy[None, :], (K, B))
         elif self.cfg.baseline == "scb":
@@ -437,9 +462,11 @@ class SCSTTrainer:
         samples_np = multihost.to_host_local(          # [K, B_local, T]
             samples, self.mesh, P(None, "data")
         ) if self.mesh is not None else np.asarray(samples)
-        greedy_np = multihost.to_host_local(
-            greedy, self.mesh, P("data")
-        ) if self.mesh is not None else np.asarray(greedy)
+        greedy_np = None
+        if greedy is not None:
+            greedy_np = multihost.to_host_local(
+                greedy, self.mesh, P("data")
+            ) if self.mesh is not None else np.asarray(greedy)
         advantage, host_metrics = self._advantage(
             greedy_np, samples_np, video_ids, valid_np
         )
