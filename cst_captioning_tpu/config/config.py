@@ -103,7 +103,10 @@ class DataConfig:
     prefetch: int = 2                   # device prefetch depth
     # keep every video's (padded) features in host RAM after the first h5
     # read: repeat epochs skip h5py entirely. Opt-in — full MSR-VTT
-    # ResNet+C3D at 28 frames is ~2 GB of f32; size it to the host
+    # ResNet+C3D at 28 frames is ~2 GB of f32; size it to the host.
+    # Cached arrays come back READ-ONLY (in-place mutation raises instead of
+    # silently poisoning later epochs); the uncached path returns fresh
+    # writable arrays — consumers that mutate features must copy first
     cache_features: bool = False
 
     def __post_init__(self):

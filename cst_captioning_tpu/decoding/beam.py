@@ -22,7 +22,11 @@ import jax
 import jax.numpy as jnp
 
 from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID
-from cst_captioning_tpu.decoding.common import apply_min_len, forbid_special
+from cst_captioning_tpu.decoding.common import (
+    apply_min_len,
+    forbid_special,
+    scan_until_finished,
+)
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
 
 _NEG = -1.0e9
@@ -51,6 +55,7 @@ def beam_search(
     min_len: int = 0,
     length_penalty: float = 0.0,
     return_all: bool = False,
+    batch_axes: tuple[str, ...] = (),
 ):
     """-> (tokens [B, T], scores [B]) — or [B, W, T] / [B, W] if return_all.
 
@@ -104,7 +109,17 @@ def beam_search(
         jnp.zeros((B, W), bool),
         jnp.full((B, W), BOS_ID, jnp.int32),
     )
-    (_, tokens, scores, _, _), _ = jax.lax.scan(step, state0, jnp.arange(T))
+    # Early exit once every beam of every row is finished — bit-identical to
+    # the full T-step unroll: with all beams finished, every continuation row
+    # is the PAD-only ``pad_row``, so the per-beam top candidate is its own
+    # frozen score at PAD, and since top_k returned ``scores`` DESCENDING on
+    # the step that finished the last beam (ties broken toward lower flat
+    # index = lower beam), the next top_k re-selects the beams in their
+    # current order: parent is the identity, tok is PAD everywhere, and the
+    # whole state is a fixed point of ``step``.
+    (_, tokens, scores, _, _), _ = scan_until_finished(
+        step, state0, T, lambda s: s[3], None, batch_axes
+    )
 
     if length_penalty > 0.0:
         lengths = jnp.maximum((tokens != PAD_ID).sum(axis=-1), 1).astype(jnp.float32)

@@ -10,8 +10,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from cst_captioning_tpu.config.config import BOS_ID
-from cst_captioning_tpu.decoding.common import apply_min_len, forbid_special, step_outputs
+from cst_captioning_tpu.config.config import BOS_ID, PAD_ID
+from cst_captioning_tpu.decoding.common import (
+    apply_min_len,
+    forbid_special,
+    scan_until_finished,
+    step_outputs,
+)
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
 
 
@@ -22,8 +27,15 @@ def greedy_decode(
     masks: dict[str, jnp.ndarray],
     max_len: int | None = None,
     min_len: int = 0,
+    batch_axes: tuple[str, ...] = (),
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """-> (tokens [B, T], logprobs [B, T]); PAD/0 after EOS."""
+    """-> (tokens [B, T], logprobs [B, T]); PAD/0 after EOS.
+
+    The step loop exits as soon as every row has emitted EOS (psum'd over
+    ``batch_axes`` when the batch is sharded) — bit-identical to the full
+    unroll because post-EOS steps emit exactly (PAD, 0.0), which is what the
+    output buffers are pre-filled with.
+    """
     T = max_len or model.cfg.max_len
     enc: EncoderOutput = model.apply(params, feats, masks, method=CaptionModel.encode)
     B = enc.memory.shape[0]
@@ -41,5 +53,7 @@ def greedy_decode(
         return (carry, nxt, finished), (nxt, lp)
 
     init = (enc.carry, jnp.full((B,), BOS_ID, jnp.int32), jnp.zeros((B,), bool))
-    _, (tokens, logprobs) = jax.lax.scan(step, init, jnp.arange(T))
-    return tokens.T, logprobs.T  # scan stacks on axis 0 -> [B, T]
+    _, (tokens, logprobs) = scan_until_finished(
+        step, init, T, lambda s: s[2], (PAD_ID, 0.0), batch_axes
+    )
+    return tokens.T, logprobs.T  # ys stack on axis 0 -> [B, T]

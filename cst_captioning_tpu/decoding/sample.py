@@ -3,11 +3,13 @@
 Reference behavior: ``model.sample(feats, multinomial × K)`` — temperature
 sampling, K rollouts per video for the consensus reward (SURVEY.md §3.2,
 BASELINE config 4). The encoder pass is shared across rollouts (computed
-once); the decode loop is vmapped over K rollout RNGs, so all K×B sequences
-decode in one XLA program — the fused "one launch" design of §7 step 5.
+once, closed over by the rollout-vmapped decode step); all K×B sequences
+decode in ONE XLA program — the fused "one launch" design of §7 step 5 —
+whose loop exits as soon as every rollout of every clip has emitted EOS.
 
-RNG discipline: rollout k at step t uses ``fold_in(fold_in(key, k), t)`` —
-reproducible regardless of batch sharding or rollout count.
+RNG discipline: rollout k at step t uses ``fold_in(fold_in(key, k), t)``,
+drawn per-rollout over its [B, V] logits block — reproducible regardless of
+batch sharding or rollout count.
 """
 
 from __future__ import annotations
@@ -15,8 +17,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from cst_captioning_tpu.config.config import BOS_ID
-from cst_captioning_tpu.decoding.common import apply_min_len, forbid_special, step_outputs
+from cst_captioning_tpu.config.config import BOS_ID, PAD_ID
+from cst_captioning_tpu.decoding.common import (
+    apply_min_len,
+    forbid_special,
+    scan_until_finished,
+    step_outputs,
+)
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
 
 
@@ -30,6 +37,7 @@ def sample_decode(
     temperature: float = 1.0,
     max_len: int | None = None,
     min_len: int = 0,
+    batch_axes: tuple[str, ...] = (),
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """-> (tokens [K, B, T], logprobs [K, B, T]); PAD/0 after EOS.
 
@@ -37,28 +45,45 @@ def sample_decode(
     (the REINFORCE estimator needs log p_model, not log p_temperature).
     """
     T = max_len or model.cfg.max_len
+    K = num_rollouts
     enc: EncoderOutput = model.apply(params, feats, masks, method=CaptionModel.encode)
     B = enc.memory.shape[0]
 
-    def rollout(k_rng):
-        def step(state, t):
-            carry, token, finished = state
-            carry, logits = model.apply(
-                params, carry, token, enc, method=CaptionModel.decode_step
-            )
-            logits = apply_min_len(forbid_special(logits), t, min_len)
-            step_rng = jax.random.fold_in(k_rng, t)
-            nxt = jax.random.categorical(step_rng, logits / temperature, axis=-1)
-            nxt = nxt.astype(jnp.int32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
-            nxt, lp, finished = step_outputs(nxt, lp, finished)
-            return (carry, nxt, finished), (nxt, lp)
+    # the decode step is vmapped over the rollout axis with the encoder
+    # output CLOSED OVER (unbatched): XLA reads the memory bank once per
+    # step and fuses the additive-attention broadcast across rollouts. (A
+    # flat [K*B]-row layout with tiled memory was measured 80% slower at the
+    # flagship dims, round 5 — the tile defeats that fusion.)
+    keys = jax.vmap(lambda k: jax.random.fold_in(rng, k))(jnp.arange(K))
 
-        init = (enc.carry, jnp.full((B,), BOS_ID, jnp.int32), jnp.zeros((B,), bool))
-        _, (tokens, logprobs) = jax.lax.scan(step, init, jnp.arange(T))
-        return tokens.T, logprobs.T
+    def one_rollout_step(carry_k, token_k):
+        return model.apply(
+            params, carry_k, token_k, enc, method=CaptionModel.decode_step
+        )
 
-    keys = jax.vmap(lambda k: jax.random.fold_in(rng, k))(jnp.arange(num_rollouts))
-    tokens, logprobs = jax.vmap(rollout)(keys)
-    return tokens, logprobs
+    def step(state, t):
+        carry, token, finished = state  # carry leaves [K, B, ...]; [K, B]
+        carry, logits = jax.vmap(one_rollout_step)(carry, token)
+        logits = apply_min_len(forbid_special(logits), t, min_len)  # [K,B,V]
+        step_keys = jax.vmap(lambda k_: jax.random.fold_in(k_, t))(keys)
+        nxt = jax.vmap(
+            lambda k_, l_: jax.random.categorical(k_, l_ / temperature, axis=-1)
+        )(step_keys, logits).astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        lp = jnp.take_along_axis(logp, nxt[..., None], axis=-1)[..., 0]
+        nxt, lp, finished = step_outputs(nxt, lp, finished)
+        return (carry, nxt, finished), (nxt, lp)
+
+    init = (
+        # broadcast (no reshape): stays a view for the vmapped step
+        jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (K,) + x.shape), enc.carry
+        ),
+        jnp.full((K, B), BOS_ID, jnp.int32),
+        jnp.zeros((K, B), bool),
+    )
+    _, (tokens, logprobs) = scan_until_finished(
+        step, init, T, lambda s: s[2], (PAD_ID, 0.0), batch_axes
+    )
+    # ys stack on axis 0: [T, K, B] -> [K, B, T]
+    return tokens.transpose(1, 2, 0), logprobs.transpose(1, 2, 0)

@@ -48,10 +48,17 @@ def _local_loss_sums(model, params, feats, masks, labels, mask, weights,
     return num, den
 
 
-def make_xe_step(model, label_smoothing: float = 0.0):
-    """Single-device jitted step: (state, batch arrays) -> (state, metrics)."""
+def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False):
+    """Single-device jitted step: (state, batch arrays) -> (state, metrics).
 
-    @jax.jit
+    ``donate=True`` donates the input ``state`` buffers to the output state
+    (params + Adam moments update in place instead of double-buffering —
+    free HBM headroom on the production path). The caller must then treat
+    the passed-in state as consumed: rebind, never reuse. Off by default so
+    exactness tests can replay one state through several step variants.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: TrainState, feats, masks, labels, mask, weights):
         drng = jax.random.fold_in(state.rng, state.step)
 
@@ -70,8 +77,9 @@ def make_xe_step(model, label_smoothing: float = 0.0):
 
 
 def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
-                          axis: str = "data"):
-    """shard_map data-parallel step, exact-equivalent to the fused batch."""
+                          axis: str = "data", donate: bool = False):
+    """shard_map data-parallel step, exact-equivalent to the fused batch.
+    ``donate``: see :func:`make_xe_step`."""
 
     def device_step(state: TrainState, feats, masks, labels, mask, weights):
         drng = jax.random.fold_in(
@@ -104,7 +112,7 @@ def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
         in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(), P()),
     )
-    return jax.jit(sharded)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
 def batch_arrays(batch) -> tuple[Any, ...]:

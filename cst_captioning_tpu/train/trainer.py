@@ -137,16 +137,22 @@ class Trainer:
             if self.sp:
                 # SP params are layout-identical to the plain model's, so the
                 # state init above (plain model) feeds the SP step directly
+                # donate=True: the step consumes self.state (rebound on every
+                # call), so params + Adam moments update in place instead of
+                # double-buffering — HBM headroom on the production path
                 self.xe_step = make_sp_xe_step(
                     sp_model(cfg.model), self.mesh, cfg.train.label_smoothing,
-                    data_axis="data",
+                    data_axis="data", donate=True,
                 )
             else:
                 self.xe_step = make_parallel_xe_step(
-                    self.model, self.mesh, cfg.train.label_smoothing
+                    self.model, self.mesh, cfg.train.label_smoothing,
+                    donate=True,
                 )
         else:
-            self.xe_step = make_xe_step(self.model, cfg.train.label_smoothing)
+            self.xe_step = make_xe_step(
+                self.model, cfg.train.label_smoothing, donate=True
+            )
 
         if multihost.is_multiprocess():
             # verifiable evidence the cluster actually formed (a degraded
@@ -391,7 +397,8 @@ class Trainer:
             num_threads=cfg.rl.reward_threads,
         )
         scst = SCSTTrainer(
-            self.model, reward, cfg.rl, mesh=self.mesh, max_len=cfg.model.max_len
+            self.model, reward, cfg.rl, mesh=self.mesh,
+            max_len=cfg.model.max_len, donate=True,
         )
         rl_batcher = Batcher(
             self.train_ds,
