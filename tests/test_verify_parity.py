@@ -1,0 +1,65 @@
+"""Smoke test: the parity runbook's dry-run path executes end-to-end.
+
+scripts/verify_parity.py is the one-command resolution of the #1
+environmental blocker (absolute parity vs the reference — VERDICT r4 next
+#6); this pins that the runbook itself works TODAY on the synthetic corpus,
+so the day the reference/data appear only the inputs change.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts", "verify_parity.py",
+)
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("verify_parity", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_dry_run_end_to_end(tmp_path):
+    vp = _load()
+    report_path = tmp_path / "report.json"
+    rc = vp.main([
+        "--dry-run",
+        "--reference", str(tmp_path / "empty_ref"),
+        "--workdir", str(tmp_path / "work"),
+        "--xe-epochs", "2", "--rl-epochs", "1",
+        "--json", str(report_path),
+    ])
+    # rc 1 only means the tiny run missed the internal gate, not a failure
+    assert rc in (0, 1)
+    report = json.loads(report_path.read_text())
+    assert "unreadable" in report["reference"]["status"] \
+        or "EMPTY" in report["reference"]["status"]
+    pipe = report["pipeline"]
+    assert pipe["mode"] == "dry_run_synthetic"
+    for stage in ("xe_test_metrics", "cst_test_metrics"):
+        assert "CIDEr-D" in pipe[stage]
+    assert "internal_gate_cst_beats_xe" in report["verdict"]
+
+
+def test_reference_readout_on_populated_tree(tmp_path):
+    """A fake 'reference' tree: LoC counted (tests excluded), metric rows
+    greppable, BASELINE.md untouched without --update-baseline."""
+    vp = _load()
+    ref = tmp_path / "ref"
+    (ref / "tests").mkdir(parents=True)
+    (ref / "model.py").write_text("import torch\n" * 40)
+    (ref / "tests" / "test_model.py").write_text("assert True\n" * 99)
+    (ref / "README.md").write_text(
+        "# results\n\n| model | CIDEr |\n|---|---|\n| CST | 0.542 |\n"
+    )
+    out = vp.read_reference(str(ref), update_baseline=False)
+    assert out["status"] == "readable"
+    assert out["loc_non_test"] == 40
+    assert any("0.542" in r["line"] for r in out["metric_rows"])
