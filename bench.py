@@ -30,9 +30,14 @@ Beyond the headline clips/s/chip, the JSON reports (VERDICT r2 next #3):
     (the pipelined epoch then overlaps the reward share with device work).
 
 Usage: python bench.py [--profile DIR] [--batch N] [--steps N] [--chunks C]
+                       [--phase rl|xe|eval|eval_e2e|scaling]
   --profile DIR  write a jax.profiler trace of the measured steps to DIR
   --chunks C     rl.update_chunks: gradient accumulation over the rollout
                  axis (C divides K=5) — lifts the HBM ceiling on batch size
+  --phase        xe: teacher-forced step; eval: beam-5 decode only;
+                 eval_e2e: decode + host tokenize/score split; scaling:
+                 weak-scaling sweep over --devices (virtual CPU mesh when
+                 real chips are insufficient)
 """
 
 from __future__ import annotations
@@ -157,6 +162,26 @@ def _xla_flops(jitted, *args) -> float:
     except Exception as e:  # pragma: no cover - backend-specific surface
         print(f"bench: cost_analysis unavailable ({e!r})", file=sys.stderr)
         return float("nan")
+
+
+def _xla_memory(jitted, *args) -> dict:
+    """Compiled-program memory footprint (bytes): argument/output/temp/alias.
+
+    ``temp`` is the live-activation high-water mark XLA plans for — the
+    number the donation / update_chunks levers move; ``alias`` is how much
+    of the argument space is donated into outputs. NaNs when unavailable.
+    """
+    try:
+        m = jitted.lower(*args).compile().memory_analysis()
+        return {
+            "argument": float(m.argument_size_in_bytes),
+            "output": float(m.output_size_in_bytes),
+            "temp": float(m.temp_size_in_bytes),
+            "alias": float(m.alias_size_in_bytes),
+        }
+    except Exception as e:  # pragma: no cover - backend-specific surface
+        print(f"bench: memory_analysis unavailable ({e!r})", file=sys.stderr)
+        return {}
 
 
 def _enc_and_per_tok_flops(
@@ -815,6 +840,10 @@ def main() -> None:
     update_flops = _xla_flops(
         scst.update, state, feats, masks, samples, adv_dev, valid_dev
     )
+    update_memory = _xla_memory(
+        scst.update, state, feats, masks, samples, adv_dev, valid_dev
+    )
+    decode_memory = _xla_memory(scst.decode, state.params, feats, masks, key2)
 
     t0 = time.perf_counter()
     for _ in range(measure_steps):
@@ -856,6 +885,7 @@ def main() -> None:
     roof = _program_roofline(batch_size, chunks=args.chunks)
     prog_secs = {"decode": dt_decode / measure_steps,
                  "update": dt_update / measure_steps}
+    prog_mem = {"decode": decode_memory, "update": update_memory}
     programs = {}
     for name, r in roof.items():
         s = prog_secs[name]
@@ -865,6 +895,9 @@ def main() -> None:
             "bytes": round(r["bytes"]),
             "mfu": round(r["flops"] / s / peak, 4),
             "bw_util": round(r["bytes"] / s / peak_hbm, 4),
+            # XLA memory_analysis: temp = planned live-activation peak,
+            # alias = donated argument bytes reused for outputs
+            "memory": prog_mem[name],
         }
     print(
         f"bench: seq shares decode={shares['decode']} reward={shares['reward']} "
