@@ -5,6 +5,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from cst_captioning_tpu.compat import pcast, vma_of
 from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID
 
 
@@ -21,10 +22,10 @@ def pcast_varying(tree, axes: tuple[str, ...]):
         return tree
 
     def cast(x):
-        vma = getattr(jax.typeof(x), "vma", frozenset())
+        vma = vma_of(x)
         for a in axes:
             if a not in vma:
-                x = jax.lax.pcast(x, a, to="varying")
+                x = pcast(x, a, to="varying")
         return x
 
     return jax.tree.map(cast, tree)

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.config.config import EvalConfig
 from cst_captioning_tpu.data.batcher import Batcher
 from cst_captioning_tpu.data.dataset import CaptionDataset
@@ -119,7 +120,7 @@ class Evaluator:
                 in_specs = (P(), P("data"), P("data"))
                 s = batch_sharding(mesh)
                 self._fm_shardings = (s, s)
-            decode = jax.shard_map(
+            decode = shard_map(
                 decode,
                 mesh=mesh,
                 in_specs=in_specs,

@@ -62,7 +62,9 @@ class MeanPoolEncoder(nn.Module):
             )(pooled)
             slots.append(jnp.tanh(emb))
         memory = jnp.stack(slots, axis=1)                        # [B, n_mod, E]
-        mmask = jnp.ones(memory.shape[:2], dtype=jnp.float32)
+        # masks are float32 framework-wide (loss/metric denominators sum
+        # them exactly); this is not compute-path data
+        mmask = jnp.ones(memory.shape[:2], dtype=jnp.float32)  # graftlint: disable=GL005
         return memory, mmask
 
 

@@ -42,6 +42,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from cst_captioning_tpu.compat import vma_of
+
 NEG = -1.0e9
 
 
@@ -125,8 +127,12 @@ def _fused_forward(q, v, memory, memory_proj, mask,
     # input varies over
     vma = frozenset()
     for x in (q, memory, memory_proj, mask):
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
-    out_shape = jax.ShapeDtypeStruct((Bp, E), memory.dtype, vma=vma)
+        vma = vma | vma_of(x)
+    if vma:
+        out_shape = jax.ShapeDtypeStruct((Bp, E), memory.dtype, vma=vma)
+    else:
+        # also the 0.4.x path, whose ShapeDtypeStruct has no vma parameter
+        out_shape = jax.ShapeDtypeStruct((Bp, E), memory.dtype)
 
     grid = (Bp // block_b, Mp // block_m)
     out = pl.pallas_call(
@@ -171,8 +177,7 @@ def fused_additive_attention(q, v, memory, memory_proj, mask,
     """
     interpret = jax.default_backend() != "tpu"
     if interpret and any(
-        getattr(jax.typeof(x), "vma", frozenset())
-        for x in (q, memory, memory_proj, mask)
+        vma_of(x) for x in (q, memory, memory_proj, mask)
     ):
         # Pallas INTERPRET mode can't execute under a varying-axis-checked
         # shard_map (the interpreter's loop constants are axis-invariant and

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.config.config import ModelConfig
 from cst_captioning_tpu.decoding import greedy_decode, sample_decode
 from cst_captioning_tpu.losses import masked_cross_entropy
@@ -72,7 +73,7 @@ def make_sp_forward(model: CaptionModel, mesh: Mesh, data_axis: str = "",
     def fwd(params, feats, masks, labels):
         return model.apply(params, feats, masks, labels)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         fwd,
         mesh=mesh,
         in_specs=(P(), f_spec, m_spec, P(b)),
@@ -124,7 +125,7 @@ def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
             samples = greedy  # stable output structure for jit
         return greedy, samples
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         dec,
         mesh=mesh,
         in_specs=(P(), f_spec, m_spec, P()),
@@ -169,7 +170,7 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
             den = jax.lax.psum(den, data_axis)
         return num / jnp.maximum(den, 1.0)
 
-    sm = jax.shard_map(
+    sm = shard_map(
         sharded_loss,
         mesh=mesh,
         in_specs=(P(), f_spec, m_spec, P(b), P(b), P(b), P()),
@@ -274,13 +275,13 @@ def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
         # collective transposes produce exact global grads — frame-sharded
         # params sum their partials, replicated-path params stay exact
         def enc_fn(p):
-            return jax.shard_map(
+            return shard_map(
                 sharded_encode, mesh=mesh,
                 in_specs=(P(), f_spec, m_spec), out_specs=enc_spec,
             )(p, feats, masks)
 
         def sums(p, e, sam_c, adv_c):
-            return jax.shard_map(
+            return shard_map(
                 sharded_sums, mesh=mesh,
                 in_specs=(P(), enc_spec, P(None, b), P(None, b), P(b)),
                 out_specs=(P(), P()),

@@ -28,6 +28,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cst_captioning_tpu.compat import pcast, shard_map
 from cst_captioning_tpu.config.config import RLConfig
 from cst_captioning_tpu.decoding import greedy_decode, sample_decode
 from cst_captioning_tpu.decoding.common import mask_from_tokens
@@ -97,7 +98,7 @@ def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
     # over ``batch_axes`` and psum the early-exit row count over it, so the
     # compiler verifies the per-shard/collective split instead of a comment
     # promising the exactness tests will.
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_decode,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P()),
@@ -211,7 +212,7 @@ def _chunked_loss_grads(model, params, feats, masks, samples, advantage,
         # inside shard_map the per-chunk grads/sums vary over the batch
         # axis; the scan carry init must carry the same varying-axis type
         init = jax.tree.map(
-            lambda x: jax.lax.pcast(x, vary_axis, to="varying"), init
+            lambda x: pcast(x, vary_axis, to="varying"), init
         )
     (gp, ge, num, den), _ = jax.lax.scan(body, init, (sam, adv))
     # vjp cotangents must match the primal dtype
@@ -302,7 +303,7 @@ def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
         state = state.apply_gradients(grads)
         return state, {"rl_loss": loss, "grad_norm": gnorm}
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_update,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(None, axis), P(None, axis), P(axis)),

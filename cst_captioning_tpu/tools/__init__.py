@@ -1,0 +1,5 @@
+"""Developer tooling that ships with the package (no runtime dependencies).
+
+``tools.graftlint`` is the JAX/TPU-aware static-analysis pass; it is wired
+into tier-1 via tests/test_graftlint.py and scripts/lint.sh.
+"""

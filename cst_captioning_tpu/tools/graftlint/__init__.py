@@ -1,0 +1,31 @@
+"""graftlint: JAX/TPU-aware static analysis for this repo.
+
+Usage: ``python -m cst_captioning_tpu.tools.graftlint [paths]`` — see
+:mod:`cst_captioning_tpu.tools.graftlint.cli` for flags, ``--list-rules``
+for the rule table, and the README "Static analysis" section for rationale,
+suppression syntax (``# graftlint: disable=GL00X``), and baseline workflow.
+"""
+
+from cst_captioning_tpu.tools.graftlint.core import (
+    Baseline,
+    FileContext,
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    find_repo_root,
+    lint_paths,
+    register,
+)
+
+__all__ = [
+    "Baseline",
+    "FileContext",
+    "Finding",
+    "LintResult",
+    "Rule",
+    "all_rules",
+    "find_repo_root",
+    "lint_paths",
+    "register",
+]

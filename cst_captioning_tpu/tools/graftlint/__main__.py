@@ -1,0 +1,5 @@
+import sys
+
+from cst_captioning_tpu.tools.graftlint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,118 @@
+"""graftlint CLI.
+
+    python -m cst_captioning_tpu.tools.graftlint [paths...] [--json]
+        [--baseline PATH | --no-baseline] [--write-baseline]
+        [--rules GL001,GL002] [--root DIR] [--list-rules]
+
+Exit codes: 0 = no new error/warning findings (info and baselined findings
+never gate), 1 = new findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from cst_captioning_tpu.tools.graftlint.core import (
+    BASELINE_NAME,
+    Baseline,
+    all_rules,
+    find_repo_root,
+    lint_paths,
+)
+
+_DEFAULT_PATHS = ("cst_captioning_tpu", "tests", "scripts")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: "
+                         f"{' '.join(_DEFAULT_PATHS)} under --root, plus "
+                         "repo-level bench*.py)")
+    ap.add_argument("--root", default="",
+                    help="repo root (default: auto-detected from cwd)")
+    ap.add_argument("--baseline", default="",
+                    help=f"baseline file (default: <root>/{BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding as new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather every current finding into the "
+                         "baseline file (reasons preserved by fingerprint) "
+                         "and exit 0")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report on stdout")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(all_rules().values(), key=lambda r: r.id):
+            print(f"{rule.id} {rule.name} [{rule.severity}]")
+            print(f"    {rule.rationale}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else find_repo_root(
+        os.getcwd()
+    )
+    paths = list(args.paths)
+    if not paths:
+        paths = [
+            os.path.join(root, p) for p in _DEFAULT_PATHS
+            if os.path.exists(os.path.join(root, p))
+        ]
+        paths += [
+            os.path.join(root, n) for n in sorted(os.listdir(root))
+            if n.startswith("bench") and n.endswith(".py")
+        ]
+    if not paths:
+        print("graftlint: nothing to lint", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    baseline = None if args.no_baseline else Baseline.load(baseline_path)
+
+    rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    try:
+        result = lint_paths(paths, root, baseline=baseline, rule_ids=rule_ids)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        old = Baseline.load(baseline_path)
+        new = Baseline.from_findings(result.findings, old=old)
+        new.save(baseline_path)
+        print(
+            f"graftlint: baselined {len(result.findings)} finding(s) into "
+            f"{os.path.relpath(baseline_path, root)} — fill in each "
+            "`reason` before committing",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        n_new, n_base = len(result.new), len(result.findings) - len(result.new)
+        print(
+            f"graftlint: {result.files_checked} file(s), "
+            f"{len(result.findings)} finding(s) "
+            f"({n_new} new, {n_base} baselined)",
+            file=sys.stderr,
+        )
+    return 1 if result.gating else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

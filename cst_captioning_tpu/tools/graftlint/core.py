@@ -1,0 +1,387 @@
+"""graftlint framework: findings, rule registry, suppressions, baseline.
+
+A stdlib-``ast`` static-analysis pass specialised for the JAX/TPU hazards of
+this codebase (host syncs inside traced code, PRNG key reuse, tracer-leak
+branches, missing buffer donation, dtype drift, heavyweight imports,
+partition-rule coverage). No third-party dependencies: the sandbox has no
+network and the linter must run wherever the tests run.
+
+Layers:
+
+- :class:`Finding`        — one diagnostic, with a line-content fingerprint
+  that survives unrelated line-number drift.
+- :class:`Rule`           — registry-registered check over a
+  :class:`FileContext`; per-rule id / severity / docs.
+- suppressions            — ``# graftlint: disable=GL001[,GL002|all]`` on the
+  offending line, or ``# graftlint: disable-next-line=...`` on the line above.
+- baseline                — a repo-root ``graftlint.baseline`` JSON of
+  grandfathered fingerprints (with a human ``reason`` each); matched findings
+  are reported but do not fail the run.
+
+The CLI lives in :mod:`cst_captioning_tpu.tools.graftlint.cli`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Callable, Iterable, Iterator
+
+SEVERITIES = ("error", "warning", "info")
+
+# rule id the framework itself emits for unparseable files
+PARSE_ERROR_RULE = "GL000"
+
+BASELINE_NAME = "graftlint.baseline"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-next-line)?)\s*=\s*([A-Za-z0-9_,\s]+)"
+)
+
+# directory names never descended into
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules", ".claude"}
+
+
+@dataclass
+class Finding:
+    """One diagnostic. ``context`` (the stripped source line) + rule + path
+    form the baseline fingerprint, so renumbering lines doesn't unbaseline."""
+
+    rule: str
+    severity: str
+    path: str            # repo-root-relative, posix separators
+    line: int
+    col: int
+    message: str
+    context: str
+    baselined: bool = False
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.context)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (baselined)" if self.baselined else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"{self.severity}: {self.message}{tag}"
+        )
+
+
+@dataclass
+class FileContext:
+    """Parsed view of one file, shared by every rule."""
+
+    path: str            # absolute
+    relpath: str         # repo-root-relative, posix
+    root: str            # repo root (absolute)
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    # line -> set of rule ids (or "all") suppressed on that line
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+    # populated lazily by rules that need it (see rules._traced_functions)
+    _cache: dict = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: str, root: str) -> "FileContext":
+        with open(path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        tree = ast.parse(source, filename=relpath)  # may raise SyntaxError
+        ctx = cls(path=path, relpath=relpath, root=root, source=source,
+                  tree=tree, lines=source.splitlines())
+        ctx.suppressions = _collect_suppressions(source)
+        return ctx
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str,
+                severity: str | None = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule=rule.id,
+            severity=severity or rule.severity,
+            path=self.relpath,
+            line=line,
+            col=col,
+            message=message,
+            context=self.line_text(line),
+        )
+
+    def suppressed(self, f: Finding) -> bool:
+        ids = self.suppressions.get(f.line, set())
+        return "all" in ids or f.rule in ids
+
+
+def _collect_suppressions(source: str) -> dict[int, set[str]]:
+    """Map line -> suppressed rule ids from ``# graftlint:`` comments."""
+    out: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            kind, ids = m.group(1), {
+                s.strip() for s in m.group(2).split(",") if s.strip()
+            }
+            line = tok.start[0] + (1 if kind.endswith("next-line") else 0)
+            out.setdefault(line, set()).update(ids)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+# ---- rule registry ----------------------------------------------------------
+
+class Rule:
+    """Base rule. Subclasses set ``id``/``name``/``severity``/``rationale``
+    and implement :meth:`check`; registration is via :func:`register`."""
+
+    id: str = ""
+    name: str = ""
+    severity: str = "warning"
+    rationale: str = ""
+
+    def applies(self, ctx: FileContext) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    rule = cls()
+    if not rule.id or rule.severity not in SEVERITIES:
+        raise ValueError(f"bad rule registration: {cls.__name__}")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    # import the rule module on first use so registration is one-time
+    from cst_captioning_tpu.tools.graftlint import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# ---- baseline ---------------------------------------------------------------
+
+@dataclass
+class Baseline:
+    """Grandfathered findings: fingerprint -> allowed count (+ a reason)."""
+
+    entries: list[dict] = field(default_factory=list)
+    path: str = ""
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or "entries" not in data:
+            raise ValueError(f"{path}: not a graftlint baseline file")
+        return cls(entries=list(data["entries"]), path=path)
+
+    def save(self, path: str | None = None) -> None:
+        path = path or self.path
+        data = {
+            "version": 1,
+            "comment": (
+                "Grandfathered graftlint findings. Each entry carries a "
+                "`reason` saying why the finding is intentional; remove the "
+                "entry when the code site is fixed. Regenerate with "
+                "`python -m cst_captioning_tpu.tools.graftlint "
+                "--write-baseline` (which preserves reasons by fingerprint)."
+            ),
+            "entries": sorted(
+                self.entries,
+                key=lambda e: (e["path"], e["rule"], e["context"]),
+            ),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+
+    def _counts(self) -> dict[tuple[str, str, str], int]:
+        out: dict[tuple[str, str, str], int] = {}
+        for e in self.entries:
+            key = (e["rule"], e["path"], e["context"])
+            out[key] = out.get(key, 0) + int(e.get("count", 1))
+        return out
+
+    def apply(self, findings: list[Finding]) -> None:
+        """Mark findings covered by the baseline, first-come first-served
+        per fingerprint (extra occurrences stay new)."""
+        budget = self._counts()
+        for f in findings:
+            key = f.fingerprint()
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+                f.baselined = True
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding],
+                      old: "Baseline | None" = None) -> "Baseline":
+        """Baseline every (non-suppressed) finding; reasons carried over from
+        ``old`` by fingerprint, placeholder otherwise."""
+        reasons: dict[tuple[str, str, str], str] = {}
+        if old is not None:
+            for e in old.entries:
+                reasons[(e["rule"], e["path"], e["context"])] = e.get(
+                    "reason", ""
+                )
+        grouped: dict[tuple[str, str, str], dict] = {}
+        for f in findings:
+            key = f.fingerprint()
+            if key in grouped:
+                grouped[key]["count"] += 1
+            else:
+                grouped[key] = {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "context": f.context,
+                    "count": 1,
+                    "reason": reasons.get(
+                        key, "TODO: justify or fix this finding"
+                    ),
+                }
+        return cls(entries=list(grouped.values()),
+                   path=old.path if old is not None else "")
+
+
+# ---- driver -----------------------------------------------------------------
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/directories into a sorted, deduped .py file list."""
+    seen: set[str] = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p.endswith(".py") and p not in seen:
+                seen.add(p)
+                yield p
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs if d not in _SKIP_DIRS and not d.startswith(".")
+            )
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    fp = os.path.join(root, n)
+                    if fp not in seen:
+                        seen.add(fp)
+                        yield fp
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]
+    files_checked: int
+
+    @property
+    def new(self) -> list[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    @property
+    def gating(self) -> list[Finding]:
+        """New findings that fail the run (info never gates)."""
+        return [f for f in self.new if f.severity in ("error", "warning")]
+
+    def to_json(self) -> dict:
+        counts = {"total": len(self.findings),
+                  "new": len(self.new),
+                  "baselined": len(self.findings) - len(self.new),
+                  "by_rule": {}}
+        for f in self.findings:
+            counts["by_rule"][f.rule] = counts["by_rule"].get(f.rule, 0) + 1
+        return {
+            "version": 1,
+            "tool": "graftlint",
+            "files_checked": self.files_checked,
+            "counts": counts,
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+def find_repo_root(start: str) -> str:
+    """Nearest ancestor containing a baseline file, .git, or the package."""
+    d = os.path.abspath(start)
+    while True:
+        if (
+            os.path.exists(os.path.join(d, BASELINE_NAME))
+            or os.path.isdir(os.path.join(d, ".git"))
+            or os.path.isdir(os.path.join(d, "cst_captioning_tpu"))
+        ):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.path.abspath(start)
+        d = parent
+
+
+def lint_paths(
+    paths: Iterable[str],
+    root: str,
+    baseline: Baseline | None = None,
+    rule_ids: Iterable[str] | None = None,
+    on_file: Callable[[str], None] | None = None,
+) -> LintResult:
+    rules = all_rules()
+    if rule_ids is not None:
+        unknown = set(rule_ids) - set(rules)
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+        rules = {k: v for k, v in rules.items() if k in set(rule_ids)}
+
+    findings: list[Finding] = []
+    n_files = 0
+    for path in iter_py_files(paths):
+        n_files += 1
+        if on_file is not None:
+            on_file(path)
+        try:
+            ctx = FileContext.parse(path, root)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule=PARSE_ERROR_RULE,
+                severity="error",
+                path=os.path.relpath(path, root).replace(os.sep, "/"),
+                line=int(e.lineno or 1),
+                col=int(e.offset or 0),
+                message=f"syntax error: {e.msg}",
+                context="",
+            ))
+            continue
+        for rule in rules.values():
+            if not rule.applies(ctx):
+                continue
+            for f in rule.check(ctx):
+                if not ctx.suppressed(f):
+                    findings.append(f)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if baseline is not None:
+        baseline.apply(findings)
+    return LintResult(findings=findings, files_checked=n_files)

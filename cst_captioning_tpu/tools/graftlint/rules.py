@@ -1,0 +1,835 @@
+"""graftlint rules: the JAX/TPU hazards this codebase has actually hit.
+
+Each rule's ``rationale`` is one line of "why this is a bug here"; the README
+"Static analysis" section is generated from these strings (keep them short).
+
+Rule ids are stable (baseline fingerprints and inline suppressions reference
+them); add new rules with new ids, never renumber.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+from cst_captioning_tpu.tools.graftlint.core import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+# ---- shared AST helpers -----------------------------------------------------
+
+# call-position names that trace their function arguments into XLA programs
+_TRACERS = {
+    "jit", "pjit", "shard_map", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "vmap", "pmap", "grad", "value_and_grad", "vjp", "jvp",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "associative_scan",
+}
+
+_HOT_PACKAGES = (
+    "cst_captioning_tpu/train/", "cst_captioning_tpu/rl/",
+    "cst_captioning_tpu/decoding/",
+)
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.lax.scan' for a Name/Attribute chain, '' when not one."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _last(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_tracer_call(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    return bool(d) and not d.startswith(("self.", "cls.")) and _last(d) in _TRACERS
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    """True for @jax.jit / @pjit / @functools.partial(jax.jit, ...) style."""
+    if isinstance(dec, ast.Call):
+        d = _dotted(dec.func)
+        if _last(d) == "partial" and dec.args:
+            return _last(_dotted(dec.args[0])) in _TRACERS
+        return _last(d) in _TRACERS
+    return _last(_dotted(dec)) in _TRACERS
+
+
+def traced_node_ids(ctx: FileContext) -> set[int]:
+    """ids of every AST node lexically inside a traced function.
+
+    A function counts as traced when decorated by a tracer (``@jax.jit``,
+    ``@functools.partial(jax.jit, ...)``) or passed by name (or as an inline
+    lambda) into a tracer call (``jax.jit(f)``, ``jax.lax.scan(body, ...)``,
+    ``shard_map(step, ...)``). Functions nested inside traced functions are
+    traced too (they run under the same trace).
+    """
+    cached = ctx._cache.get("traced_ids")
+    if cached is not None:
+        return cached
+
+    name_defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, _FUNC_NODES):
+            name_defs.setdefault(node.name, []).append(node)
+
+    entries: list[ast.AST] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, _FUNC_NODES) and any(
+            _decorator_traces(d) for d in node.decorator_list
+        ):
+            entries.append(node)
+        if isinstance(node, ast.Call) and _is_tracer_call(node):
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in args:
+                if isinstance(arg, ast.Lambda):
+                    entries.append(arg)
+                elif isinstance(arg, ast.Name) and arg.id in name_defs:
+                    entries.extend(name_defs[arg.id])
+
+    ids: set[int] = set()
+    for entry in entries:
+        for node in ast.walk(entry):
+            ids.add(id(node))
+    ctx._cache["traced_ids"] = ids
+    return ids
+
+
+def _in_package(ctx: FileContext) -> bool:
+    return ctx.relpath.startswith("cst_captioning_tpu/")
+
+
+def _is_test_file(ctx: FileContext) -> bool:
+    base = os.path.basename(ctx.relpath)
+    return base.startswith("test_") or ctx.relpath.startswith("tests/")
+
+
+# ---- GL001: host sync on the device hot path --------------------------------
+
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_SYNC_DOTTED = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "device_get", "jax.block_until_ready",
+}
+
+
+def _sync_call(node: ast.AST) -> str | None:
+    """Name of the host-sync primitive a call node invokes, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_ATTRS:
+        return f".{node.func.attr}()"
+    d = _dotted(node.func)
+    if d in _SYNC_DOTTED:
+        return d
+    if d == "float":
+        return "float()"
+    return None
+
+
+@register
+class HostSyncRule(Rule):
+    id = "GL001"
+    name = "host-sync-in-hot-path"
+    severity = "error"
+    rationale = (
+        "a device_get/.item()/float()/np.asarray inside a traced function "
+        "(or unconditionally inside a per-step loop) serializes the dispatch "
+        "pipeline — the device idles while the host blocks on the transfer"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        traced = traced_node_ids(ctx)
+        for node in ast.walk(ctx.tree):
+            prim = _sync_call(node)
+            if prim and id(node) in traced:
+                out.append(ctx.finding(
+                    self, node,
+                    f"host-sync call {prim} inside a jit/scan-traced "
+                    "function: the trace either fails at runtime or (via a "
+                    "constant-folded host value) hides a device round-trip",
+                ))
+        if self._loop_scope(ctx):
+            out.extend(self._check_step_loops(ctx, traced))
+        return out
+
+    @staticmethod
+    def _loop_scope(ctx: FileContext) -> bool:
+        """The per-step-loop heuristic only applies where loop bodies drive
+        jitted steps: jax-importing modules of the train/rl/decoding
+        packages. Host-side modules (the numpy reward scorer, metrics, data)
+        and benchmarks/tests sync deliberately — measuring or asserting IS
+        a readback."""
+        if not ctx.relpath.startswith(_HOT_PACKAGES):
+            return False
+        return any(
+            isinstance(n, ast.Import) and any(
+                a.name == "jax" or a.name.startswith("jax.")
+                for a in n.names
+            )
+            or isinstance(n, ast.ImportFrom) and (n.module or "").split(
+                "."
+            )[0] == "jax"
+            for n in ast.walk(ctx.tree)
+        )
+
+    def _check_step_loops(self, ctx: FileContext,
+                          traced: set[int]) -> list[Finding]:
+        """Flag syncs that run on EVERY iteration of a for/while loop: direct
+        statements and `if` tests, but not gated `if` bodies (logging every N
+        steps is a deliberate, amortized sync)."""
+        out: dict[tuple[int, int, str], Finding] = {}
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            if id(loop) in traced:
+                continue  # the traced-scope pass above already covers these
+            stack: list[ast.AST] = list(loop.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+                    continue  # closures run on their own schedule
+                if isinstance(node, ast.If):
+                    stack.extend(ast.walk(node.test))
+                    continue
+                prim = _sync_call(node)
+                # int() of a device scalar (step counters read off the train
+                # state, metrics dict entries) is the sneakiest per-step
+                # sync; only the loop pass flags it — inside a trace int()
+                # is a plain shape computation, and int() of host strings/
+                # counters is everyday Python, so gate on the argument
+                # LOOKING like device state
+                if prim is None and isinstance(node, ast.Call) \
+                        and _dotted(node.func) == "int" and node.args:
+                    try:
+                        arg_src = ast.unparse(node.args[0])
+                    except Exception:  # pragma: no cover - defensive
+                        arg_src = ""
+                    if re.search(r"state|\bstep\b|metrics|\bm\[", arg_src):
+                        prim = "int()"
+                if prim:
+                    key = (node.lineno, node.col_offset, prim)
+                    if key not in out:
+                        out[key] = ctx.finding(
+                            self, node,
+                            f"per-step host sync: {prim} runs every "
+                            "iteration of this step loop, blocking dispatch "
+                            "of the next step; defer the readback "
+                            "(accumulate device values, convert once per "
+                            "epoch) or gate it behind a log-every-N branch",
+                            severity="warning",
+                        )
+                for child in ast.iter_child_nodes(node):
+                    stack.append(child)
+        return list(out.values())
+
+
+# ---- GL002: PRNG key reuse --------------------------------------------------
+
+_KEY_CONSUMERS = {
+    "categorical", "normal", "uniform", "bernoulli", "gumbel", "choice",
+    "permutation", "randint", "bits", "exponential", "laplace", "truncated_normal",
+    "dirichlet", "beta", "gamma", "poisson", "shuffle",
+}
+_KEY_BASES = {"jax.random", "random", "jrandom", "jr"}
+
+
+@register
+class KeyReuseRule(Rule):
+    id = "GL002"
+    name = "prng-key-reuse"
+    severity = "error"
+    rationale = (
+        "passing one key to two jax.random consumers yields CORRELATED "
+        "draws — in SCST the K rollouts stop exploring independently and "
+        "the REINFORCE baseline silently biases"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # tests reuse keys deliberately (determinism assertions)
+        return not _is_test_file(ctx)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FUNC_NODES):
+                out.extend(self._check_function(ctx, node))
+        return out
+
+    def _check_function(self, ctx: FileContext, fn: ast.AST) -> list[Finding]:
+        # events in source order: key consumptions and name (re)bindings,
+        # nested functions excluded (separate scopes, analyzed on their own)
+        events: list[tuple[int, int, str, str, ast.AST]] = []
+
+        def visit(node, depth=0):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES + (ast.Lambda,)):
+                    continue
+                if isinstance(child, ast.Call):
+                    d = _dotted(child.func)
+                    base, _, attr = d.rpartition(".")
+                    if base in _KEY_BASES and attr in _KEY_CONSUMERS and child.args:
+                        key_expr = child.args[0]
+                        try:
+                            key_src = ast.unparse(key_expr)
+                        except Exception:  # pragma: no cover - defensive
+                            key_src = ""
+                        if key_src:
+                            events.append((
+                                child.lineno, child.col_offset,
+                                "consume", key_src, child,
+                            ))
+                if isinstance(child, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                      ast.For, ast.withitem, ast.NamedExpr)):
+                    for name in _bound_names(child):
+                        events.append((
+                            getattr(child, "lineno", 0),
+                            getattr(child, "col_offset", 0),
+                            "bind", name, child,
+                        ))
+                visit(child, depth + 1)
+
+        visit(fn)
+        events.sort(key=lambda e: (e[0], e[1]))
+
+        live: dict[str, ast.AST] = {}  # key expr -> first consuming call
+        out: list[Finding] = []
+        for _, _, kind, payload, node in events:
+            if kind == "bind":
+                # any key expression mentioning the rebound name is refreshed
+                for expr in [e for e in live
+                             if re.search(rf"\b{re.escape(payload)}\b", e)]:
+                    del live[expr]
+            else:
+                if payload in live:
+                    first = live[payload]
+                    out.append(ctx.finding(
+                        self, node,
+                        f"PRNG key {payload!r} already consumed by a "
+                        f"jax.random call on line {first.lineno}; split or "
+                        "fold_in before reusing it (identical keys give "
+                        "identical draws)",
+                    ))
+                else:
+                    live[payload] = node
+        return out
+
+
+def _bound_names(node: ast.AST) -> list[str]:
+    targets: list[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.For):
+        targets = [node.target]
+    elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+        targets = [node.optional_vars]
+    elif isinstance(node, ast.NamedExpr):
+        targets = [node.target]
+    out: list[str] = []
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                out.append(sub.id)
+    return out
+
+
+# ---- GL003: Python control flow on traced values ----------------------------
+
+_TENSOR_BASES = {"jnp", "jax.numpy", "lax", "jax.lax", "jax.nn"}
+
+
+@register
+class TracedBranchRule(Rule):
+    id = "GL003"
+    name = "python-branch-on-traced-value"
+    severity = "error"
+    rationale = (
+        "`if`/`while` on a jnp/lax value inside a traced function raises "
+        "ConcretizationTypeError at best — or, when the value is accidentally "
+        "concrete, silently burns one retrace per Python branch outcome"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        traced = traced_node_ids(ctx)
+        out: list[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, _FUNC_NODES) or id(fn) not in traced:
+                continue
+            tensor_names: set[str] = set()
+            for node in ast.iter_child_nodes(fn):
+                out.extend(self._scan(ctx, node, tensor_names))
+        # dedupe (nested traced functions are walked once per enclosing entry)
+        seen: set[tuple[int, int]] = set()
+        uniq = []
+        for f in out:
+            if (f.line, f.col) not in seen:
+                seen.add((f.line, f.col))
+                uniq.append(f)
+        return uniq
+
+    def _scan(self, ctx, node, tensor_names, depth=0) -> list[Finding]:
+        out: list[Finding] = []
+        if isinstance(node, ast.Assign) and self._is_tensor_expr(
+            node.value, tensor_names
+        ):
+            tensor_names.update(_bound_names(node))
+        if isinstance(node, (ast.If, ast.While)) and self._is_tensor_expr(
+            node.test, tensor_names
+        ):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            out.append(ctx.finding(
+                self, node,
+                f"Python `{kind}` on a traced jnp/lax value: use jnp.where / "
+                "lax.cond / lax.while_loop (or hoist the decision to static "
+                "config) so the branch stays inside the XLA program",
+            ))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES + (ast.Lambda,)):
+                continue
+            out.extend(self._scan(ctx, child, tensor_names, depth + 1))
+        return out
+
+    @staticmethod
+    def _is_tensor_expr(expr: ast.AST, tensor_names: set[str]) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                base = _dotted(node.func).rpartition(".")[0]
+                if base in _TENSOR_BASES:
+                    return True
+            if isinstance(node, ast.Name) and node.id in tensor_names:
+                return True
+        return False
+
+
+# ---- GL004: train/update steps jitted without donation ----------------------
+
+_STEP_NAME = re.compile(r"(step|update)", re.IGNORECASE)
+_DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+@register
+class DonationRule(Rule):
+    id = "GL004"
+    name = "jit-step-without-donation"
+    severity = "warning"
+    rationale = (
+        "jitting a train/update step without donate_argnums double-buffers "
+        "params + optimizer state in HBM — the exact memory ceiling "
+        "BASELINE.md hit at batch 1024; donation must be an explicit choice"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        enclosing = _enclosing_function_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FUNC_NODES):
+                # a *train* step carries mutable state (params/optimizer);
+                # decode/eval "step" functions don't, and donating their
+                # inputs buys nothing — require a state-like parameter
+                has_state = any(
+                    "state" in a.arg for a in node.args.args + node.args.kwonlyargs
+                )
+                for dec in node.decorator_list:
+                    if has_state and self._jit_without_donation(dec) \
+                            and _STEP_NAME.search(node.name):
+                        out.append(self._finding(ctx, dec, node.name))
+            if isinstance(node, ast.Call) and _last(_dotted(node.func)) in (
+                "jit", "pjit"
+            ):
+                if any(kw.arg in _DONATE_KWARGS for kw in node.keywords):
+                    continue
+                target = ""
+                if node.args and isinstance(node.args[0], ast.Name):
+                    target = node.args[0].id
+                owner = enclosing.get(id(node), "")
+                subject = target if _STEP_NAME.search(target) else (
+                    owner if _STEP_NAME.search(owner) else ""
+                )
+                if subject:
+                    out.append(self._finding(ctx, node, subject))
+        return out
+
+    @staticmethod
+    def _jit_without_donation(dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            d = _dotted(dec.func)
+            if _last(d) == "partial" and dec.args and _last(
+                _dotted(dec.args[0])
+            ) in ("jit", "pjit"):
+                return not any(kw.arg in _DONATE_KWARGS for kw in dec.keywords)
+            if _last(d) in ("jit", "pjit"):
+                return not any(kw.arg in _DONATE_KWARGS for kw in dec.keywords)
+            return False
+        return _last(_dotted(dec)) in ("jit", "pjit")
+
+    def _finding(self, ctx, node, subject) -> Finding:
+        return ctx.finding(
+            self, node,
+            f"{subject!r} looks like a train/update step but is jitted "
+            "without donate_argnums/donate_argnames: its input state "
+            "double-buffers in HBM. Pass donation explicitly (an empty "
+            "tuple is fine when replay semantics are wanted)",
+        )
+
+
+def _enclosing_function_names(ctx: FileContext) -> dict[int, str]:
+    """node id -> name of the nearest enclosing function ('' at module)."""
+    cached = ctx._cache.get("enclosing_fn")
+    if cached is not None:
+        return cached
+    out: dict[int, str] = {}
+
+    def walk(node, owner):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                out[id(child)] = owner
+                walk(child, child.name)
+            else:
+                out[id(child)] = owner
+                walk(child, owner)
+
+    walk(ctx.tree, "")
+    ctx._cache["enclosing_fn"] = out
+    return out
+
+
+# ---- GL005: float32 literals in bf16-annotated modules ----------------------
+
+_CREATORS = {
+    "zeros": 1, "ones": 1, "empty": 1, "array": 1, "asarray": 1,
+    "full": 2, "full_like": 2,
+}
+
+
+@register
+class F32LiteralRule(Rule):
+    id = "GL005"
+    name = "f32-literal-in-bf16-module"
+    severity = "warning"
+    rationale = (
+        "an explicit float32 array literal in a bf16 compute module upcasts "
+        "every op it touches off the MXU fast path; route dtypes through "
+        "cfg.dtype or mark the f32 accumulation intentional"
+    )
+
+    # the packages whose code executes under the model's compute dtype;
+    # tests/benches build f32 INPUT data on purpose (the model casts), so
+    # merely containing the string "bfloat16" does not put a file in scope
+    _SCOPE = (
+        "cst_captioning_tpu/models/", "cst_captioning_tpu/ops/",
+        "cst_captioning_tpu/losses/", "cst_captioning_tpu/parallel/",
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.relpath.startswith(self._SCOPE)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            base, _, attr = d.rpartition(".")
+            if base not in ("jnp", "jax.numpy", "np", "numpy"):
+                continue
+            if attr not in _CREATORS:
+                continue
+            dtype = None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dtype = kw.value
+            pos = _CREATORS[attr]
+            if dtype is None and len(node.args) > pos:
+                dtype = node.args[pos]
+            if dtype is not None and self._is_f32(dtype):
+                out.append(ctx.finding(
+                    self, node,
+                    f"float32 literal via {d}(...) in a bf16-annotated "
+                    "module: pass the module's compute dtype (cfg.dtype) or "
+                    "suppress with a comment when f32 accumulation is the "
+                    "point",
+                ))
+        return out
+
+    @staticmethod
+    def _is_f32(node: ast.AST) -> bool:
+        if isinstance(node, ast.Constant) and node.value == "float32":
+            return True
+        return _dotted(node) in (
+            "jnp.float32", "np.float32", "numpy.float32", "jax.numpy.float32",
+        )
+
+
+# ---- GL006: heavyweight imports / module-level device work ------------------
+
+_FORBIDDEN_IMPORTS = {
+    "torch", "torchvision", "tensorflow", "keras", "theano", "pandas",
+    "matplotlib", "sklearn", "pycocoevalcap", "nltk",
+}
+# module-scope calls that initialize the backend / touch devices at import
+_DEVICE_CALLS = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.default_backend", "jax.device_put",
+    "jax.process_index", "jax.process_count",
+}
+_DEVICE_PREFIXES = ("jax.random.",)
+
+
+@register
+class HeavyImportRule(Rule):
+    id = "GL006"
+    name = "heavy-import-or-import-side-effect"
+    severity = "error"
+    rationale = (
+        "hot-path packages must stay importable in milliseconds with no "
+        "backend init: a stray torch/tensorflow import or module-level "
+        "jax.devices() makes every CLI, test, and subprocess pay for it"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            mods: list[str] = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [node.module]
+            for mod in mods:
+                top = mod.split(".", 1)[0]
+                if top in _FORBIDDEN_IMPORTS:
+                    out.append(ctx.finding(
+                        self, node,
+                        f"forbidden heavyweight import {mod!r}: this "
+                        "codebase is jax+numpy only (no network to install "
+                        "extras; host metrics stay in metrics/)",
+                    ))
+        out.extend(self._module_scope_device_work(ctx))
+        return out
+
+    def _module_scope_device_work(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, _FUNC_NODES + (ast.ClassDef,)):
+                continue
+            if _is_main_guard(stmt):
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+                    # defs nested in module-level if/try: bodies run later
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                if d in _DEVICE_CALLS or d.startswith(_DEVICE_PREFIXES):
+                    out.append(ctx.finding(
+                        self, node,
+                        f"module-level {d}(...) initializes the JAX backend "
+                        "at import time: importing this file grabs the TPU "
+                        "(or pays CPU-client startup) before any CLI flag or "
+                        "env guard can run; move it under main() or a "
+                        "__main__ guard",
+                    ))
+        return out
+
+
+def _is_main_guard(stmt: ast.AST) -> bool:
+    return (
+        isinstance(stmt, ast.If)
+        and isinstance(stmt.test, ast.Compare)
+        and isinstance(stmt.test.left, ast.Name)
+        and stmt.test.left.id == "__name__"
+    )
+
+
+# ---- GL007: partition-rule coverage vs the sharding contract ----------------
+
+@register
+class PartitionCoverageRule(Rule):
+    id = "GL007"
+    name = "partition-rule-coverage"
+    severity = "error"
+    rationale = (
+        "a PartitionSpec rule regex that matches no param (or a param no "
+        "rule covers) means a model refactor silently changed the sharded "
+        "layout; the contract dump pins the param tree the rules were "
+        "written against"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return "PARAM_PARTITION_RULES" in ctx.source
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        rules_node = None
+        contract_rel = None
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                names = _bound_names(node)
+                if "PARAM_PARTITION_RULES" in names:
+                    rules_node = node
+                if "SHARDING_CONTRACT" in names and isinstance(
+                    node.value, ast.Constant
+                ):
+                    contract_rel = str(node.value.value)
+        if rules_node is None:
+            return []
+
+        regexes: list[tuple[str, str, ast.AST]] = []  # (family, pattern, node)
+        for elt in getattr(rules_node.value, "elts", []):
+            parts = getattr(elt, "elts", [])
+            if len(parts) >= 2 and isinstance(parts[0], ast.Constant) \
+                    and isinstance(parts[1], ast.Constant):
+                regexes.append((str(parts[0].value), str(parts[1].value), elt))
+
+        out: list[Finding] = []
+        if contract_rel is None:
+            return [ctx.finding(
+                self, rules_node,
+                "PARAM_PARTITION_RULES defined without a SHARDING_CONTRACT "
+                "path: the rules cannot be cross-checked against the param "
+                "tree",
+            )]
+        contract_path = contract_rel if os.path.isabs(contract_rel) else \
+            os.path.join(ctx.root, contract_rel)
+        if not os.path.exists(contract_path):
+            return [ctx.finding(
+                self, rules_node,
+                f"sharding contract {contract_rel!r} not found: run "
+                "`python scripts/check_shardings.py --write` to dump the "
+                "param tree",
+                severity="info",
+            )]
+        try:
+            with open(contract_path, encoding="utf-8") as f:
+                params = list(json.load(f)["params"])
+        except (OSError, ValueError, KeyError) as e:
+            return [ctx.finding(
+                self, rules_node,
+                f"sharding contract {contract_rel!r} unreadable: {e}",
+            )]
+
+        unruled = set(params)
+        for family, pattern, node in regexes:
+            try:
+                rx = re.compile(pattern)
+            except re.error as e:
+                out.append(ctx.finding(
+                    self, node,
+                    f"partition rule {family!r} has an invalid regex: {e}",
+                ))
+                continue
+            matched = [p for p in params if rx.fullmatch(p)]
+            if not matched:
+                out.append(ctx.finding(
+                    self, node,
+                    f"partition rule {family!r} ({pattern!r}) matches no "
+                    "parameter in the contract dump — the param family it "
+                    "was written for was renamed or removed",
+                ))
+            unruled.difference_update(matched)
+        for p in sorted(unruled):
+            out.append(ctx.finding(
+                self, rules_node,
+                f"parameter {p!r} (from the contract dump) matches no "
+                "partition rule: add a rule for its family so its (future) "
+                "sharding is an explicit decision",
+            ))
+        return out
+
+
+# ---- GL008: TPU-only test imports without the slow marker -------------------
+
+_TPU_ONLY_PREFIXES = (
+    "cst_captioning_tpu.ops",
+    "jax.experimental.pallas",
+    "jax.experimental.mosaic",
+)
+
+
+@register
+class TpuTestMarkerRule(Rule):
+    id = "GL008"
+    name = "tpu-test-without-slow-marker"
+    severity = "warning"
+    rationale = (
+        "tier-1 runs `-m 'not slow'` on CPU everywhere; a test importing "
+        "TPU-only kernel modules must either run in interpret mode "
+        "(baseline it, with the reason) or carry @pytest.mark.slow"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _is_test_file(ctx) and os.path.basename(
+            ctx.relpath
+        ).startswith("test_")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        tpu_import = None
+        tpu_mod = ""
+        for node in ast.walk(ctx.tree):
+            mods: list[str] = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [node.module]
+            for mod in mods:
+                if mod.startswith(_TPU_ONLY_PREFIXES):
+                    tpu_import, tpu_mod = node, mod
+                    break
+            if tpu_import is not None:
+                break
+        if tpu_import is None:
+            return []
+
+        if self._module_marked_slow(ctx.tree):
+            return []
+        unmarked = [
+            fn.name for fn in ast.walk(ctx.tree)
+            if isinstance(fn, _FUNC_NODES) and fn.name.startswith("test_")
+            and not self._marked_slow(fn)
+        ]
+        if not unmarked:
+            return []
+        return [ctx.finding(
+            self, tpu_import,
+            f"imports TPU-only module {tpu_mod!r} but {len(unmarked)} test "
+            "function(s) lack @pytest.mark.slow "
+            f"({', '.join(unmarked[:4])}{'…' if len(unmarked) > 4 else ''}); "
+            "mark them slow, or baseline this file with the reason it is "
+            "CPU-safe (e.g. Pallas interpret mode)",
+        )]
+
+    @staticmethod
+    def _marked_slow(fn: ast.AST) -> bool:
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _dotted(target).endswith("mark.slow"):
+                return True
+        return False
+
+    @staticmethod
+    def _module_marked_slow(tree: ast.Module) -> bool:
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and "pytestmark" in _bound_names(
+                node
+            ):
+                for sub in ast.walk(node.value):
+                    if _dotted(sub).endswith("mark.slow"):
+                        return True
+        return False

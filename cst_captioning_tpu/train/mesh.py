@@ -12,6 +12,8 @@ step).
 
 from __future__ import annotations
 
+import re
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -47,6 +49,85 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 def shard_batch(mesh: Mesh, batch, axis: str = "data"):
     """Place a host batch pytree with axis 0 split across the mesh."""
     return jax.device_put(batch, batch_sharding(mesh, axis))
+
+
+# ---- parameter partition contract ------------------------------------------
+#
+# (family, path regex, PartitionSpec) for every parameter family of the
+# caption model. v1 trains pure data-parallel — the reference is DP-only —
+# so every family maps to P() (replicated); the row's value is the CONTRACT,
+# not the spec: ``scripts/check_shardings.py`` dumps the real param tree
+# into SHARDING_CONTRACT, and graftlint rule GL007 cross-checks that every
+# regex still matches at least one parameter and every parameter is covered
+# by some rule. A model refactor that renames a family then fails the
+# linter instead of silently falling out of the (future model-parallel)
+# sharded layout. Order matters: first match wins in param_partition_specs.
+PARAM_PARTITION_RULES: tuple[tuple[str, str, P], ...] = (
+    ("encoder_embed", r"params/encoder/embed_[^/]+/.*", P()),
+    ("carry_init", r"params/init_[hc]\d+/.*", P()),
+    ("decoder_attention", r"params/cell/attention/.*", P()),
+    ("decoder_lstm", r"params/cell/lstm\d+/.*", P()),
+    ("word_embed", r"params/cell/word_embed/.*", P()),
+    ("output_head", r"params/cell/out_proj/.*", P()),
+)
+
+# repo-root-relative dump of the model param tree the rules above were
+# written against (regenerate: `python scripts/check_shardings.py --write`)
+SHARDING_CONTRACT = "scripts/shardings_contract.json"
+
+
+def param_path_names(params) -> list[str]:
+    """Flat '/'-joined key paths of a param pytree (the contract's naming)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for keypath, _ in flat:
+        parts = []
+        for k in keypath:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:  # pragma: no cover - defensive
+                parts.append(str(k))
+        out.append("/".join(parts))
+    return out
+
+
+def rule_coverage(param_names) -> tuple[list[str], list[str]]:
+    """-> (families matching no param, params matched by no family)."""
+    unmatched = []
+    unruled = set(param_names)
+    for family, pattern, _ in PARAM_PARTITION_RULES:
+        rx = re.compile(pattern)
+        hits = [p for p in param_names if rx.fullmatch(p)]
+        if not hits:
+            unmatched.append(family)
+        unruled.difference_update(hits)
+    return unmatched, sorted(unruled)
+
+
+def param_partition_specs(params):
+    """PartitionSpec pytree for ``params`` by first-matching family rule.
+
+    Raises ``ValueError`` on an unruled parameter — an unruled param must be
+    an explicit decision (add a family rule), never a silent default.
+    """
+    names = param_path_names(params)
+    specs = []
+    for name in names:
+        for _, pattern, spec in PARAM_PARTITION_RULES:
+            if re.fullmatch(pattern, name):
+                specs.append(spec)
+                break
+        else:
+            raise ValueError(
+                f"parameter {name!r} matches no PARAM_PARTITION_RULES entry; "
+                "add a family rule for it (scripts/check_shardings.py "
+                "verifies coverage)"
+            )
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    assert len(flat) == len(specs)
+    return jax.tree_util.tree_unflatten(treedef, specs)
 
 
 def replicate(mesh: Mesh, tree):

@@ -36,6 +36,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cst_captioning_tpu.compat import distributed_is_initialized
+
 # NOTE: jax.experimental.multihost_utils must NOT be imported at module
 # level: importing it initializes the XLA backend, after which a later
 # jax.distributed.initialize silently degrades to a single-process cluster
@@ -72,7 +74,7 @@ def initialize(coordinator_address: str | None = None,
     """
     # NOTE: must not touch jax.process_count()/jax.devices() here — any
     # backend-initializing call before jax.distributed.initialize is an error
-    if jax.distributed.is_initialized():
+    if distributed_is_initialized():
         return
     if os.environ.get("JAX_PLATFORMS"):
         # pin the platform list via config BEFORE distributed init: with a

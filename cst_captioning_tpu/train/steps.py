@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.losses import masked_cross_entropy
 from cst_captioning_tpu.train.state import TrainState
 
@@ -106,7 +107,7 @@ def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
         state = state.apply_gradients(grads)
         return state, {"loss": loss, "grad_norm": gnorm}
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         device_step,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis)),

@@ -1,0 +1,133 @@
+"""Sharding-contract dump/verify: the param tree PARAM_PARTITION_RULES binds.
+
+The mesh layer (cst_captioning_tpu/train/mesh.py) names every parameter
+family of the caption model in ``PARAM_PARTITION_RULES`` — a (family, path
+regex, PartitionSpec) table that is the single place a future model-parallel
+layout will be declared. This script pins the table to reality:
+
+- ``--write``   dumps the model's parameter path names (via ``jax.eval_shape``
+  — zero device work, runs under ``JAX_PLATFORMS=cpu`` in milliseconds) into
+  ``scripts/shardings_contract.json``, the checked-in contract.
+- default mode  re-derives the names, diffs them against the contract, and
+  checks rule coverage both ways (every rule matches ≥1 param, every param
+  matched by ≥1 rule). Nonzero exit on any drift.
+
+graftlint rule GL007 reads the same contract file purely statically (no jax
+import), so `python -m cst_captioning_tpu.tools.graftlint` catches a renamed
+param family even on machines that never build the model.
+
+The dump covers BOTH encoder variants (meanpool and temporal_attention) and
+a 2-layer LSTM so every declarable family appears in the contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def contract_param_names() -> list[str]:
+    """Union of param path names over the representative model configs."""
+    import jax
+    import jax.numpy as jnp
+
+    from cst_captioning_tpu.config.config import ModelConfig
+    from cst_captioning_tpu.models import CaptionModel
+    from cst_captioning_tpu.train.mesh import param_path_names
+
+    names: set[str] = set()
+    for encoder in ("meanpool", "temporal_attention"):
+        cfg = ModelConfig(
+            vocab_size=64,
+            modalities=(("resnet", 16), ("c3d", 8)),
+            d_embed=8, d_hidden=8, d_att=4,
+            encoder=encoder, num_layers=2,
+            max_len=4, max_frames=3,
+        )
+        model = CaptionModel(cfg)
+        feats = {"resnet": jnp.zeros((1, 3, 16)), "c3d": jnp.zeros((1, 3, 8))}
+        masks = {k: jnp.ones((1, 3)) for k in feats}
+        labels = jnp.zeros((1, 4), jnp.int32)
+        tree = jax.eval_shape(
+            lambda m=model: m.init(jax.random.key(0), feats, masks, labels)
+        )
+        names.update(param_path_names(tree))
+    return sorted(names)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--write", action="store_true",
+                    help="(re)write the contract dump instead of verifying")
+    ap.add_argument("--contract", default="",
+                    help="contract path (default: from mesh.SHARDING_CONTRACT)")
+    args = ap.parse_args(argv)
+
+    from cst_captioning_tpu.train.mesh import (
+        PARAM_PARTITION_RULES,
+        SHARDING_CONTRACT,
+        rule_coverage,
+    )
+
+    contract_path = args.contract or os.path.join(REPO, SHARDING_CONTRACT)
+    names = contract_param_names()
+
+    if args.write:
+        with open(contract_path, "w", encoding="utf-8") as f:
+            json.dump({
+                "comment": (
+                    "Param-tree contract for mesh.PARAM_PARTITION_RULES; "
+                    "regenerate with `python scripts/check_shardings.py "
+                    "--write` after model refactors. Verified by this "
+                    "script's default mode and by graftlint GL007."
+                ),
+                "params": names,
+            }, f, indent=2)
+            f.write("\n")
+        print(f"check_shardings: wrote {len(names)} param path(s) to "
+              f"{os.path.relpath(contract_path, REPO)}")
+        return 0
+
+    ok = True
+    if not os.path.exists(contract_path):
+        print(f"check_shardings: contract {contract_path} missing — run "
+              "with --write first", file=sys.stderr)
+        return 1
+    with open(contract_path, encoding="utf-8") as f:
+        recorded = list(json.load(f)["params"])
+    added = sorted(set(names) - set(recorded))
+    removed = sorted(set(recorded) - set(names))
+    if added or removed:
+        ok = False
+        for p in added:
+            print(f"check_shardings: param {p!r} is NEW vs the contract "
+                  "(regenerate with --write and re-check rule coverage)",
+                  file=sys.stderr)
+        for p in removed:
+            print(f"check_shardings: param {p!r} vanished from the model "
+                  "(regenerate with --write; drop its rule if the family "
+                  "is gone)", file=sys.stderr)
+
+    unmatched, unruled = rule_coverage(names)
+    for fam in unmatched:
+        ok = False
+        print(f"check_shardings: rule family {fam!r} matches no parameter",
+              file=sys.stderr)
+    for p in unruled:
+        ok = False
+        print(f"check_shardings: parameter {p!r} matches no rule family",
+              file=sys.stderr)
+    if ok:
+        print(f"check_shardings: OK — {len(names)} params, "
+              f"{len(PARAM_PARTITION_RULES)} families, full coverage both "
+              "ways")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

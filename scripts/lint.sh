@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Pre-commit gate: graftlint + a full bytecode compile.
+#
+#   scripts/lint.sh
+#
+# Exits nonzero on (a) any NEW graftlint finding — baselined findings pass,
+# see graftlint.baseline — or (b) any file that doesn't byte-compile.
+# tier-1 runs the same graftlint check via tests/test_graftlint.py
+# (test_repo_is_graftlint_clean), so CI cannot drift from this script.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# AST pass only — no JAX backend, no device, sub-second
+python -m cst_captioning_tpu.tools.graftlint \
+    cst_captioning_tpu tests scripts \
+    bench.py bench_attention.py bench_recipe.py
+
+# catches syntax errors in files graftlint may not reach (non-.py-suffixed
+# entry points aside, this is the whole tree)
+python -m compileall -q cst_captioning_tpu tests scripts \
+    bench.py bench_attention.py bench_recipe.py
+
+echo "lint.sh: OK"

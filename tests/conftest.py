@@ -34,3 +34,13 @@ except AttributeError:
 assert not _backends, (
     "a JAX backend was initialized before conftest ran; CPU forcing is too late"
 )
+
+
+def pytest_configure(config):
+    # tier-1 filters with `-m "not slow"`; register the marker so strict
+    # marker modes and --markers stay accurate (graftlint GL008 enforces it
+    # on TPU-only test imports)
+    config.addinivalue_line(
+        "markers", "slow: needs real TPU hardware or long wall-clock; "
+        "excluded from tier-1 (-m 'not slow')"
+    )

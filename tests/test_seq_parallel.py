@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.config.config import ModelConfig, TrainConfig
 from cst_captioning_tpu.models import CaptionModel
 from cst_captioning_tpu.parallel import (
@@ -116,7 +117,7 @@ def test_sp_beam_search_matches_single_device(setup):
     mesh = mesh_1d()
     spm = sp_model(cfg)
     f, m = _place(mesh, cfg, feats, masks)
-    sharded = jax.jit(jax.shard_map(
+    sharded = jax.jit(shard_map(
         lambda p, fe, ma: beam_search(spm, p, fe, ma, beam_size=3, max_len=T)[0],
         mesh=mesh,
         in_specs=(P(),) + sp_batch_specs(cfg),
