@@ -1,10 +1,13 @@
-"""Checkpointing: atomic msgpack saves, best/latest policy, XE->RL handoff.
+"""Checkpointing: durable msgpack saves, best/latest/step_* policy, handoff.
 
 Capability parity with the reference's ``torch.save`` of model/optimizer/
-``infos`` + ``--start_from`` resume (SURVEY.md §3.5, §5): atomic writes (tmp +
-rename) so a crash never corrupts the latest checkpoint, ``resume="auto"``
-picks the newest valid one, and the RL phase loads params-only from the best
-XE checkpoint with a fresh optimizer.
+``infos`` + ``--start_from`` resume (SURVEY.md §3.5, §5), hardened by the
+resilience layer: fsync'd atomic writes with a checksum manifest verified on
+load, a demoted ``<name>.prev`` generation as crash fallback, mid-epoch
+``step_*`` checkpoints with keep-last-K rotation, and ``resume="auto"``
+picking the newest checkpoint that passes verification (corrupt candidates
+are logged as ``ckpt_corrupt`` events, never silently skipped). The RL phase
+loads params-only from the best XE checkpoint with a fresh optimizer.
 """
 
 from cst_captioning_tpu.ckpt.checkpoint import (
@@ -13,5 +16,12 @@ from cst_captioning_tpu.ckpt.checkpoint import (
     load_state,
     save_state,
 )
+from cst_captioning_tpu.resilience.durable import CorruptCheckpointError
 
-__all__ = ["CheckpointManager", "save_state", "load_state", "load_params"]
+__all__ = [
+    "CheckpointManager",
+    "CorruptCheckpointError",
+    "save_state",
+    "load_state",
+    "load_params",
+]

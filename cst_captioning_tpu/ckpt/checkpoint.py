@@ -1,28 +1,47 @@
-"""Atomic TrainState checkpoints via flax.serialization msgpack.
+"""Durable TrainState checkpoints via flax.serialization msgpack.
 
-Layout per checkpoint name (e.g. ``best`` / ``latest`` / ``step_1200``):
+Layout per checkpoint name (``best`` / ``latest`` / ``step_00001200``):
 
     <dir>/<name>/state.msgpack   — params + opt state + step + rng
-    <dir>/<name>/infos.json      — epoch, metric history, config snapshot
+    <dir>/<name>/infos.json      — epoch, phase, batch_index, config snapshot
+    <dir>/<name>/manifest.json   — sha256 + size per file, verified on load
 
 msgpack via ``flax.serialization`` (not pickle) keeps checkpoints
-language-neutral and safe to load; writes go to a tmp dir + atomic rename.
+language-neutral and safe to load. Durability (resilience/durable.py):
+every file is fsync'd, the tmp dir is fsync'd, the swap is ``os.replace``,
+and the parent dir is fsync'd after — a host crash at ANY instant leaves
+either the old or the new checkpoint fully intact. An existing checkpoint is
+demoted to ``<name>.prev`` (not deleted) before the swap, so even the
+replace window and a post-"success" torn write have a fallback generation.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
-from typing import Any
+from typing import Any, Callable
 
 import jax
 from flax import serialization
 
+from cst_captioning_tpu.resilience import chaos
+from cst_captioning_tpu.resilience.durable import (
+    CorruptCheckpointError,
+    MANIFEST_FILE,
+    fsync_dir,
+    verify_manifest,
+    write_bytes_durable,
+    write_manifest,
+)
+from cst_captioning_tpu.resilience.retry import RetryPolicy, retry_call
 from cst_captioning_tpu.train.state import TrainState
 
 STATE_FILE = "state.msgpack"
 INFOS_FILE = "infos.json"
+
+_STEP_NAME_RE = re.compile(r"^step_(\d+)$")
 
 
 def _is_prng_key(x) -> bool:
@@ -47,32 +66,50 @@ def _data_to_keys(loaded, template):
 
 def save_state(ckpt_dir: str, name: str, state: TrainState,
                infos: dict[str, Any] | None = None) -> str:
-    """Atomically write state+infos under ``ckpt_dir/name``; returns the path.
+    """Durably write state+infos under ``ckpt_dir/name``; returns the path.
 
     CONTRACT: one writer per ``ckpt_dir`` at a time — crash-atomic (a kill
-    mid-save leaves only the stale ``.tmp``, reclaimed by the next save),
-    not concurrency-atomic (directory swap is rmtree+rename). Multi-host
-    runs satisfy this via the Trainer's process-0 checkpoint gate."""
+    mid-save leaves the previous generation intact: only the stale ``.tmp``
+    is lost, reclaimed by the next save; a kill inside the swap leaves the
+    demoted ``<name>.prev``), not concurrency-atomic. Multi-host runs
+    satisfy this via the Trainer's process-0 checkpoint gate."""
     final = os.path.join(ckpt_dir, name)
     tmp = final + ".tmp"
+    chaos.visit("ckpt.save")
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp, exist_ok=True)
     # fully materialize on host before serializing
     host_state = _keys_to_data(jax.device_get(state))
-    with open(os.path.join(tmp, STATE_FILE), "wb") as f:
-        f.write(serialization.to_bytes(host_state))
-    with open(os.path.join(tmp, INFOS_FILE), "w") as f:
-        json.dump(infos or {}, f, indent=2, default=float)
+    state_bytes = serialization.to_bytes(host_state)
+    infos_bytes = json.dumps(infos or {}, indent=2, default=float).encode()
+    write_bytes_durable(os.path.join(tmp, STATE_FILE), state_bytes)
+    chaos.visit("ckpt.state_written")
+    write_bytes_durable(os.path.join(tmp, INFOS_FILE), infos_bytes)
+    write_manifest(tmp, {STATE_FILE: state_bytes, INFOS_FILE: infos_bytes})
+    fsync_dir(tmp)
+    chaos.visit("ckpt.pre_replace")
     if os.path.exists(final):
-        shutil.rmtree(final)
+        # demote, don't delete: the previous generation survives both a
+        # crash inside this swap and a latent torn write in the new files
+        prev = final + ".prev"
+        if os.path.exists(prev):
+            shutil.rmtree(prev)
+        os.replace(final, prev)
     os.replace(tmp, final)
+    fsync_dir(ckpt_dir)
     return final
 
 
 def load_state(ckpt_dir: str, name: str, template: TrainState) -> tuple[TrainState, dict]:
-    """Restore a full TrainState (shape/dtype from ``template``) + infos."""
+    """Restore a full TrainState (shape/dtype from ``template``) + infos.
+
+    Verifies the manifest checksums first (when present — legacy checkpoints
+    without one load unverified); raises
+    :class:`~cst_captioning_tpu.resilience.durable.CorruptCheckpointError`
+    on any mismatch instead of deserializing a torn file."""
     path = os.path.join(ckpt_dir, name)
+    verify_manifest(path)
     data_template = _keys_to_data(jax.device_get(template))
     with open(os.path.join(path, STATE_FILE), "rb") as f:
         loaded = serialization.from_bytes(data_template, f.read())
@@ -87,20 +124,36 @@ def load_state(ckpt_dir: str, name: str, template: TrainState) -> tuple[TrainSta
 
 def load_params(ckpt_dir: str, name: str, params_template) -> Any:
     """Params-only restore — the XE -> RL handoff (fresh optimizer)."""
-    path = os.path.join(ckpt_dir, name, STATE_FILE)
-    with open(path, "rb") as f:
+    path = os.path.join(ckpt_dir, name)
+    verify_manifest(path)
+    with open(os.path.join(path, STATE_FILE), "rb") as f:
         blob = f.read()
     state_dict = serialization.msgpack_restore(blob)
     return serialization.from_state_dict(params_template, state_dict["params"])
 
 
-class CheckpointManager:
-    """best-by-metric + latest policy with auto-resume (SURVEY.md §5)."""
+def _read_infos(path: str) -> dict:
+    """Best-effort infos.json read for candidate ordering (not for load)."""
+    try:
+        with open(os.path.join(path, INFOS_FILE), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
 
-    def __init__(self, ckpt_dir: str, metric: str = "CIDEr-D", mode: str = "max"):
+
+class CheckpointManager:
+    """best-by-metric + latest policy, mid-epoch ``step_*`` checkpoints with
+    keep-last-K rotation, and checksum-verified auto-resume (SURVEY.md §5)."""
+
+    def __init__(self, ckpt_dir: str, metric: str = "CIDEr-D", mode: str = "max",
+                 keep: int = 3, log: Callable[..., None] | None = None,
+                 retry: RetryPolicy | None = None):
         self.ckpt_dir = ckpt_dir
         self.metric = metric
         self.mode = mode
+        self.keep = keep
+        self.log = log or (lambda event, **fields: None)
+        self.retry = retry or RetryPolicy()
         self.best_value: float | None = None
         os.makedirs(ckpt_dir, exist_ok=True)
         # recover best_value from an existing best checkpoint (resume case)
@@ -113,6 +166,14 @@ class CheckpointManager:
         if self.best_value is None:
             return True
         return value > self.best_value if self.mode == "max" else value < self.best_value
+
+    def _save(self, name: str, state: TrainState, infos: dict) -> str:
+        """One durable save with jittered-backoff retries on transient I/O."""
+        return retry_call(
+            save_state, self.ckpt_dir, name, state, infos,
+            policy=self.retry,
+            on_retry=lambda info: self.log("ckpt_retry", name=name, **info),
+        )
 
     def save(self, state: TrainState, value: float | None = None,
              infos: dict | None = None) -> bool:
@@ -127,18 +188,73 @@ class CheckpointManager:
         # both checkpoints carry the post-update best so 'latest' metadata
         # never lags 'best' (ADVICE r1)
         infos["best_value"] = self.best_value
-        save_state(self.ckpt_dir, "latest", state, infos)
+        self._save("latest", state, infos)
         if improved:
-            save_state(self.ckpt_dir, "best", state, infos)
+            self._save("best", state, infos)
         return improved
 
+    def save_step(self, state: TrainState, step: int,
+                  infos: dict | None = None) -> str:
+        """Mid-epoch ``step_<n>`` checkpoint + keep-last-``keep`` rotation."""
+        infos = dict(infos or {})
+        infos.setdefault("global_step", int(step))
+        infos["best_value"] = self.best_value
+        path = self._save(f"step_{int(step):08d}", state, infos)
+        if self.keep > 0:
+            for _, name in self.step_checkpoints()[:-self.keep]:
+                shutil.rmtree(
+                    os.path.join(self.ckpt_dir, name), ignore_errors=True
+                )
+        return path
+
+    def step_checkpoints(self) -> list[tuple[int, str]]:
+        """Existing ``step_*`` checkpoint (step, dirname) pairs, ascending."""
+        out = []
+        for entry in os.listdir(self.ckpt_dir):
+            m = _STEP_NAME_RE.match(entry)
+            if m and os.path.isdir(os.path.join(self.ckpt_dir, entry)):
+                out.append((int(m.group(1)), entry))
+        return sorted(out)
+
+    def _candidates(self) -> list[str]:
+        """Restore candidates, newest first.
+
+        Ordered by the recorded ``global_step`` (epoch-end and mid-epoch
+        saves share one clock), tie-broken by role: an in-flight ``latest``
+        beats a ``step_*`` beats ``best`` beats any demoted ``*.prev``
+        generation. Legacy checkpoints without ``global_step`` sort last in
+        role order — exactly the old latest-then-best behavior."""
+        rank = {"latest": 3, "best": 1}
+        cands = []
+        for entry in sorted(os.listdir(self.ckpt_dir)):
+            path = os.path.join(self.ckpt_dir, entry)
+            if entry.endswith(".tmp") or not os.path.isdir(path):
+                continue
+            if not os.path.exists(os.path.join(path, STATE_FILE)):
+                continue
+            base = entry[:-5] if entry.endswith(".prev") else entry
+            role = 0 if entry.endswith(".prev") else (
+                rank.get(base, 2 if _STEP_NAME_RE.match(base) else 0)
+            )
+            step = _read_infos(path).get("global_step")
+            cands.append((-1 if step is None else int(step), role, entry))
+        return [e for _, _, e in sorted(cands, reverse=True)]
+
     def restore_latest(self, template: TrainState) -> tuple[TrainState, dict] | None:
-        """Auto-resume: newest valid checkpoint (latest, falling back to best)."""
-        for name in ("latest", "best"):
-            path = os.path.join(self.ckpt_dir, name, STATE_FILE)
-            if os.path.exists(path):
-                try:
-                    return load_state(self.ckpt_dir, name, template)
-                except Exception:
-                    continue  # corrupt/partial: try the next candidate
+        """Auto-resume: newest checkpoint that passes verification.
+
+        A corrupt/partial candidate is never silently skipped: each failure
+        is logged as a structured ``ckpt_corrupt`` event (candidate name,
+        error class, detail) before falling back to the next generation."""
+        for name in self._candidates():
+            try:
+                return load_state(self.ckpt_dir, name, template)
+            except Exception as e:
+                self.log(
+                    "ckpt_corrupt",
+                    name=name,
+                    error=type(e).__name__,
+                    detail=str(e),
+                )
+                continue  # verified-corrupt (and logged): try the next one
         return None

@@ -132,6 +132,20 @@ class TrainConfig:
     weight_decay: float = 0.0
     label_smoothing: float = 0.0
     loss: str = "xe"                    # "xe" | "wxe"
+
+    def __post_init__(self):
+        if self.on_divergence not in ("off", "skip_batch", "rollback", "abort"):
+            raise ValueError(
+                f"unknown on_divergence policy {self.on_divergence!r} "
+                "(expected 'off', 'skip_batch', 'rollback', or 'abort')"
+            )
+        if self.ckpt_every_steps < 0 or self.keep_ckpts < 1 or self.max_rollbacks < 0:
+            raise ValueError(
+                "resilience knobs out of range: ckpt_every_steps >= 0, "
+                "keep_ckpts >= 1, max_rollbacks >= 0 required "
+                f"(got {self.ckpt_every_steps}, {self.keep_ckpts}, "
+                f"{self.max_rollbacks})"
+            )
     # per-step JSONL events (loss/reward + grad_norm every N steps; 0 = off,
     # keeping logs to per-epoch summaries)
     log_every_steps: int = 0
@@ -142,6 +156,19 @@ class TrainConfig:
     profile_dir: str = ""               # jax.profiler trace output dir ("" = off)
     profile_steps: int = 10             # steps to trace (after the compile step)
     debug_nans: bool = False            # jax_debug_nans sanitizer mode
+    # ---- resilience (resilience/ package; README "Preemption-safe training")
+    # mid-epoch step_<n> checkpoint interval, in steps (0 = epoch-end saves
+    # only; SIGTERM-triggered saves happen regardless)
+    ckpt_every_steps: int = 0
+    keep_ckpts: int = 3                 # keep-last-K rotation for step_* ckpts
+    # divergence sentinel policy: "off" | "skip_batch" (on-device guard
+    # excludes the non-finite update, run continues) | "rollback" (restore
+    # last-good checkpoint, re-randomize data order) | "abort"
+    on_divergence: str = "skip_batch"
+    # loss-spike sentinel: flag a finite loss > factor * median(recent
+    # window); 0 = NaN/inf detection only
+    spike_factor: float = 0.0
+    max_rollbacks: int = 2              # rollback budget per run before aborting
 
 
 @dataclass(frozen=True)

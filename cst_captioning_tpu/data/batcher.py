@@ -78,6 +78,10 @@ class Batcher:
         self.seq_per_vid = seq_per_vid
         self.seed = seed
         self.epoch_index = 0  # set from the checkpoint epoch on resume
+        # divergence-rollback salt (resilience/sentinel.py): 0 keeps the
+        # historical (seed, epoch) keying bit-for-bit; a rollback bumps it so
+        # the replayed epochs draw a fresh — still deterministic — order
+        self.salt = 0
         self.drop_last = drop_last
         # multi-host data feeding (train/multihost.py): every process forms
         # the SAME global batch order — the shuffle is keyed by (seed,
@@ -118,7 +122,11 @@ class Batcher:
         # unshuffled epochs (eval, template peeks) consume no epoch index
         rng = None
         if shuffle:
-            rng = np.random.default_rng((self.seed, self.epoch_index))
+            key = (
+                (self.seed, self.epoch_index) if not self.salt
+                else (self.seed, self.salt, self.epoch_index)
+            )
+            rng = np.random.default_rng(key)
             self.epoch_index += 1
         items = self._items(rng)
         bs = self.batch_size

@@ -23,6 +23,7 @@ def prefetch_to_device(
     sharding: Any | None = None,
     transform: Callable[[Any], Any] | None = None,
     place: bool = True,
+    stop_event: threading.Event | None = None,
 ) -> Iterator[Any]:
     """Iterate ``it``, staging ``size`` elements ahead onto device.
 
@@ -33,6 +34,11 @@ def prefetch_to_device(
     ``place=False`` skips the internal ``device_put`` — for items that mix
     device arrays with host-only leaves (e.g. video-id strings for the RL
     reward), ``transform`` does its own placement of the array part.
+
+    ``stop_event`` (optional) makes the staging thread quit before its next
+    collate/transfer once set — the preemption path: when SIGTERM lands, the
+    grace window should go to the checkpoint fsync, not to prefetching
+    batches that will never run. Items already staged are still yielded.
     """
     if not place:
         _place = lambda x: x
@@ -65,6 +71,8 @@ def prefetch_to_device(
     def worker():
         try:
             for x in it:
+                if stop_event is not None and stop_event.is_set():
+                    return  # preempting: yield only what's already staged
                 x = transform(x) if transform is not None else x
                 x = _place(x)
                 if not _put(x):

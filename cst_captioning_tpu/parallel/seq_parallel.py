@@ -38,6 +38,7 @@ from cst_captioning_tpu.config.config import ModelConfig
 from cst_captioning_tpu.decoding import greedy_decode, sample_decode
 from cst_captioning_tpu.losses import masked_cross_entropy
 from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.train.steps import _apply
 from cst_captioning_tpu.train.state import TrainState
 
 
@@ -136,7 +137,8 @@ def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
 
 def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
                     label_smoothing: float = 0.0, data_axis: str = "",
-                    seq_axis: str = "seq", donate: bool = False) -> Callable:
+                    seq_axis: str = "seq", donate: bool = False,
+                    guard: bool = False) -> Callable:
     """Jitted SP (optionally DP x SP) XE train step.
 
     The loss is computed inside shard_map (loss psum'd over ``data_axis``
@@ -186,15 +188,14 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
-        state = state.apply_gradients(grads)
-        return state, {"loss": loss, "grad_norm": gnorm}
+        return _apply(state, grads, loss, gnorm, guard)
 
     return step
 
 
 def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
                       seq_axis: str = "seq", chunks: int = 1,
-                      donate: bool = False) -> Callable:
+                      donate: bool = False, guard: bool = False) -> Callable:
     """Jitted DP x SP REINFORCE update (the SCST update on a 2-D mesh).
 
     Same structure as :func:`make_sp_xe_step`: the (numerator, denominator)
@@ -337,8 +338,7 @@ def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
-        state = state.apply_gradients(grads)
-        return state, {"rl_loss": loss, "grad_norm": gnorm}
+        return _apply(state, grads, loss, gnorm, guard, key="rl_loss")
 
     return update
 

@@ -1,0 +1,58 @@
+"""Fault-tolerance layer: durable checkpoints, divergence sentinel, retry,
+preemption handling, and a deterministic chaos (fault-injection) harness.
+
+The ROADMAP north-star is a production-scale system; at that scale TPU
+preemptions, NaN batches, and flaky filesystem/reward-service I/O are routine
+events, not exceptional ones (Podracer arXiv:2104.06272 and RLAX
+arXiv:2512.06392 both treat them as first-class design inputs). This package
+makes each of them a *tested* code path:
+
+- :mod:`durable`  — fsync'd atomic checkpoint writes + a sidecar manifest of
+  per-file checksums, verified on load (a truncated ``state.msgpack`` is
+  detected, not deserialized into garbage).
+- :mod:`sentinel` — NaN/inf + loss-spike detection over the step loops with a
+  configurable policy: ``skip_batch`` (the device-side guard already excluded
+  the update), ``rollback`` (restore last-good checkpoint, re-randomize the
+  data order), or ``abort``.
+- :mod:`guard`    — the on-device finite-update guard shared by every jitted
+  step (`jnp.where(ok, new, old)` over params/opt_state/step).
+- :mod:`retry`    — budgeted, jittered exponential backoff for host-side
+  fallible I/O (checkpoint writes, the RL reward scorer).
+- :mod:`preempt`  — SIGTERM handling: set a flag, let the step loop save a
+  mid-epoch checkpoint recording the exact batch index, and exit cleanly.
+- :mod:`chaos`    — seeded fault plans (NaN-poisoned batches, kill-mid-save,
+  transient I/O errors, slow/failing reward calls, preemption signals) driven
+  by the tests through named injection points compiled into the hot paths.
+"""
+
+from cst_captioning_tpu.resilience.chaos import Fault, FaultPlan, SimulatedKill
+from cst_captioning_tpu.resilience.durable import (
+    CorruptCheckpointError,
+    verify_manifest,
+    write_manifest,
+)
+from cst_captioning_tpu.resilience.guard import guarded_apply_gradients
+from cst_captioning_tpu.resilience.preempt import Preempted, PreemptionHandler
+from cst_captioning_tpu.resilience.retry import RetryPolicy, retry_call
+from cst_captioning_tpu.resilience.sentinel import (
+    DivergenceSentinel,
+    RollbackRequested,
+    TrainingDiverged,
+)
+
+__all__ = [
+    "CorruptCheckpointError",
+    "DivergenceSentinel",
+    "Fault",
+    "FaultPlan",
+    "Preempted",
+    "PreemptionHandler",
+    "RetryPolicy",
+    "RollbackRequested",
+    "SimulatedKill",
+    "TrainingDiverged",
+    "guarded_apply_gradients",
+    "retry_call",
+    "verify_manifest",
+    "write_manifest",
+]

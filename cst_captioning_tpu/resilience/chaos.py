@@ -1,0 +1,186 @@
+"""Deterministic fault injection: seeded plans fired at named code points.
+
+Production hot paths call :func:`visit` at a handful of named injection
+points (``"xe.step"``, ``"ckpt.save"``, ``"reward.call"``, ...). With no
+active plan that is one module-global ``None`` check — free. Tests activate a
+:class:`FaultPlan` and every listed :class:`Fault` fires at an exact visit
+index of its point, so a chaos run is bit-reproducible: the same plan always
+kills the same save, poisons the same batch, fails the same reward call.
+
+Fault kinds:
+
+- ``"kill"``     — raise :class:`SimulatedKill` (a ``BaseException``: it
+  models a process death, so ``except Exception`` recovery paths must NOT
+  swallow it).
+- ``"preempt"``  — deliver a real ``SIGTERM`` to this process (exercises the
+  actual :class:`~cst_captioning_tpu.resilience.preempt.PreemptionHandler`
+  signal path, not a shortcut flag).
+- ``"io_error"`` — raise :class:`TransientIOError` (an ``OSError``) for
+  ``times`` consecutive visits starting at ``at`` — the retry-helper fodder.
+- ``"nan"``      — poison the visited payload (a ``data.batcher.Batch``):
+  every feature array becomes NaN, so the forward pass diverges on device.
+- ``"slow"``     — ``time.sleep(delay)``, modelling a stalled reward service.
+
+Injection points currently compiled in:
+
+=================  =========================================================
+``xe.step``        XE train loop, once per dispatched step (main thread)
+``xe.batch``       XE host batch prep, payload = the ``Batch`` (prefetch thread)
+``rl.step``        RL train loop, once per completed step (main thread)
+``rl.batch``       RL host batch prep, payload = the ``Batch`` (prefetch thread)
+``ckpt.save``      entry of ``save_state`` (before any file is written)
+``ckpt.state_written``  after ``state.msgpack`` hits the tmp dir
+``ckpt.pre_replace``    tmp dir complete + fsync'd, final rename not yet done
+``reward.call``    inside the retried RL reward invocation
+=================  =========================================================
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+
+class SimulatedKill(BaseException):
+    """A chaos-injected process death. BaseException on purpose: recovery
+    code that catches ``Exception`` must not accidentally 'survive' a kill."""
+
+
+class TransientIOError(OSError):
+    """A chaos-injected transient I/O failure (retryable)."""
+
+
+@dataclass
+class Fault:
+    """One scheduled fault.
+
+    ``at`` is the 0-based visit index of ``point`` that triggers; pass
+    ``("rand", lo, hi)`` to have :class:`FaultPlan` draw it from the plan
+    seed (deterministic per seed). ``times`` widens io_error/nan/slow faults
+    to that many consecutive visits.
+    """
+
+    point: str
+    kind: str  # "kill" | "preempt" | "io_error" | "nan" | "slow"
+    at: Any = 0
+    times: int = 1
+    delay: float = 0.0
+
+    _KINDS = ("kill", "preempt", "io_error", "nan", "slow")
+
+    def __post_init__(self):
+        if self.kind not in self._KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.times < 1:
+            raise ValueError(f"fault times {self.times} must be >= 1")
+
+    def window(self) -> range:
+        return range(self.at, self.at + self.times)
+
+
+class FaultPlan:
+    """A seeded, activatable schedule of faults.
+
+    Use as a context manager::
+
+        plan = FaultPlan([Fault("xe.step", "preempt", at=7)], seed=3)
+        with plan.activate():
+            trainer.train_xe()
+        assert plan.fired  # [{"point": "xe.step", "kind": "preempt", ...}]
+
+    Only one plan can be active per process at a time (they model
+    process-level failures). ``plan.fired`` records every triggered fault in
+    order for test assertions.
+    """
+
+    def __init__(self, faults: list[Fault], seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.seed = seed
+        self.faults: list[Fault] = []
+        for f in faults:
+            if isinstance(f.at, tuple):
+                tag, lo, hi = f.at
+                if tag != "rand":
+                    raise ValueError(f"bad fault at-spec {f.at!r}")
+                f = Fault(f.point, f.kind, int(rng.integers(lo, hi)),
+                          f.times, f.delay)
+            self.faults.append(f)
+        self.fired: list[dict] = []
+        self._visits: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def activate(self) -> "_Activation":
+        return _Activation(self)
+
+    def visits(self, point: str) -> int:
+        with self._lock:
+            return self._visits.get(point, 0)
+
+    def _visit(self, point: str, payload: Any) -> Any:
+        with self._lock:
+            idx = self._visits.get(point, 0)
+            self._visits[point] = idx + 1
+            due = [f for f in self.faults
+                   if f.point == point and idx in f.window()]
+            for f in due:
+                self.fired.append(
+                    {"point": point, "kind": f.kind, "visit": idx}
+                )
+        # fire outside the lock: handlers/sleeps must not serialize threads
+        for f in due:
+            if f.kind == "kill":
+                raise SimulatedKill(f"chaos kill at {point}#{idx}")
+            if f.kind == "io_error":
+                raise TransientIOError(f"chaos io_error at {point}#{idx}")
+            if f.kind == "preempt":
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif f.kind == "slow":
+                time.sleep(f.delay)
+            elif f.kind == "nan":
+                payload = _poison(payload)
+        return payload
+
+
+@dataclass
+class _Activation:
+    plan: FaultPlan
+    _token: Any = field(default=None, repr=False)
+
+    def __enter__(self) -> FaultPlan:
+        global _ACTIVE
+        if _ACTIVE is not None:
+            raise RuntimeError("another FaultPlan is already active")
+        _ACTIVE = self.plan
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = None
+
+
+_ACTIVE: FaultPlan | None = None
+
+
+def _poison(payload: Any) -> Any:
+    """NaN-poison a batch payload in place (features only: labels stay valid
+    so the loss itself, not the int pipeline, is what diverges)."""
+    if payload is None:
+        raise ValueError("nan fault fired at a point with no batch payload")
+    feats = getattr(payload, "feats", payload)
+    for arr in feats.values() if hasattr(feats, "values") else [feats]:
+        arr[:] = np.nan
+    return payload
+
+
+def visit(point: str, payload: Any = None) -> Any:
+    """Injection point: no-op (returning ``payload``) unless a plan is
+    active and schedules a fault at this visit of ``point``."""
+    if _ACTIVE is None:
+        return payload
+    return _ACTIVE._visit(point, payload)

@@ -1,0 +1,107 @@
+"""Durable file primitives: fsync'd writes + a checksum manifest sidecar.
+
+``os.replace`` alone only orders the rename against other *metadata*
+operations; after a host crash the freshly renamed checkpoint can still read
+back as zeros/truncated unless the data files AND the directories were
+fsync'd first. The manifest (``manifest.json``) records a sha256 + size per
+checkpoint file so a torn write is *detected at load time* instead of being
+deserialized into garbage params.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Mapping
+
+MANIFEST_FILE = "manifest.json"
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint file failed its manifest checksum/size verification."""
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so entry renames/creates survive a crash. Some
+    filesystems refuse O_RDONLY dir fsync — treat that as best-effort."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        return
+    finally:
+        os.close(fd)
+
+
+def write_bytes_durable(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` and fsync the file before returning."""
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def write_manifest(dirpath: str, blobs: Mapping[str, bytes]) -> None:
+    """Write ``manifest.json`` for files already written under ``dirpath``.
+
+    Checksums come from the in-memory ``blobs`` (name -> bytes), not a
+    re-read of disk, so the manifest attests what the writer *meant* to
+    persist.
+    """
+    manifest = {
+        "version": 1,
+        "files": {
+            name: {
+                "sha256": hashlib.sha256(blob).hexdigest(),
+                "size": len(blob),
+            }
+            for name, blob in blobs.items()
+        },
+    }
+    write_bytes_durable(
+        os.path.join(dirpath, MANIFEST_FILE),
+        json.dumps(manifest, indent=2).encode(),
+    )
+
+
+def verify_manifest(dirpath: str) -> bool:
+    """Verify every file listed in ``dirpath``'s manifest.
+
+    Returns ``False`` when no manifest exists (a pre-manifest legacy
+    checkpoint: loadable, just unverifiable). Raises
+    :class:`CorruptCheckpointError` naming every mismatching file otherwise.
+    """
+    mpath = os.path.join(dirpath, MANIFEST_FILE)
+    if not os.path.exists(mpath):
+        return False
+    try:
+        with open(mpath, encoding="utf-8") as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (ValueError, KeyError, OSError) as e:
+        raise CorruptCheckpointError(f"{mpath}: unreadable manifest: {e}") from e
+    bad: list[str] = []
+    for name, meta in files.items():
+        fpath = os.path.join(dirpath, name)
+        if not os.path.exists(fpath):
+            bad.append(f"{name}: missing")
+            continue
+        size = os.path.getsize(fpath)
+        if size != int(meta["size"]):
+            bad.append(f"{name}: size {size} != {meta['size']}")
+            continue
+        h = hashlib.sha256()
+        with open(fpath, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != meta["sha256"]:
+            bad.append(f"{name}: sha256 mismatch")
+    if bad:
+        raise CorruptCheckpointError(
+            f"{dirpath}: manifest verification failed: " + "; ".join(bad)
+        )
+    return True

@@ -1,0 +1,42 @@
+"""On-device finite-update guard for jitted train steps.
+
+``jax_debug_nans`` is the debugging tool; this is the production one: when a
+step's loss or grad-norm is NaN/inf, the parameter/optimizer/step update is
+suppressed *inside the XLA program* (``jnp.where`` select against the old
+state) — no host sync, no poisoned Adam moments, and the step counter does
+not advance, so the batch is cleanly excluded. The ``nonfinite`` metric
+(device scalar, 0/1) lets the host-side
+:class:`~cst_captioning_tpu.resilience.sentinel.DivergenceSentinel` log and
+apply policy on its own (amortized) readback schedule.
+
+When every input is finite the select picks the new leaves bit-for-bit, so a
+guarded healthy run is numerically identical to an unguarded one.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def guarded_apply_gradients(state, grads, loss, grad_norm):
+    """-> (new_state, nonfinite) with the update suppressed when non-finite.
+
+    ``loss`` and ``grad_norm`` jointly witness divergence: any NaN/inf in
+    any gradient leaf makes the global norm non-finite, so per-leaf isfinite
+    scans are unnecessary. Only ``step``/``params``/``opt_state`` are
+    selected (the PRNG key and static fields are untouched by the update, and
+    ``where`` over typed key dtypes is not portable to the 0.4.x floor).
+    """
+    ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+    new = state.apply_gradients(grads)
+
+    def sel(n, o):
+        return jnp.where(ok, n, o)
+
+    guarded = new.replace(
+        step=sel(new.step, state.step),
+        params=jax.tree.map(sel, new.params, state.params),
+        opt_state=jax.tree.map(sel, new.opt_state, state.opt_state),
+    )
+    return guarded, 1.0 - ok.astype(jnp.float32)

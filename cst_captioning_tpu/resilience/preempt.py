@@ -1,0 +1,72 @@
+"""SIGTERM/preemption handling for the train loops.
+
+TPU preemption arrives as SIGTERM with a short grace window. The handler
+only sets a flag (signal handlers must not run arbitrary Python against
+half-updated trainer state); the step loop checks the flag once per step,
+saves a mid-epoch checkpoint recording the exact batch index, and raises
+:class:`Preempted` so drivers exit nonzero and the next run resumes the
+remainder of the epoch.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Iterable
+
+
+class Preempted(RuntimeError):
+    """Raised by a train loop after a preemption-triggered save completed."""
+
+
+class PreemptionHandler:
+    """Installable SIGTERM latch; context manager restores prior handlers.
+
+    Installation is best-effort: ``signal.signal`` only works in the main
+    thread, so a Trainer driven from a worker thread simply runs without
+    preemption handling (``installed`` is False) instead of crashing.
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self.installed = False
+        self._requested = threading.Event()
+        self._prev: dict[int, object] = {}
+
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def _on_signal(self, signum, frame) -> None:
+        self._requested.set()
+        prev = self._prev.get(signum)
+        # chain a pre-existing Python-level handler (e.g. an outer harness's
+        # own latch); never re-invoke SIG_DFL/SIG_IGN — default SIGTERM
+        # disposition would kill the process before the save runs
+        if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
+            prev(signum, frame)
+
+    def install(self) -> "PreemptionHandler":
+        if self.installed:
+            return self
+        try:
+            for s in self.signals:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            self.installed = True
+        except ValueError:  # not the main thread
+            self._prev.clear()
+        return self
+
+    def uninstall(self) -> None:
+        if not self.installed:
+            return
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        self.installed = False
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

@@ -1,0 +1,79 @@
+"""Budgeted, jittered exponential backoff for host-side fallible I/O.
+
+Applied to checkpoint writes (shared-filesystem hiccups under preemption
+storms) and the RL reward scorer (a remote service in production deployments;
+in-process numpy here, but the call site is the same). Deterministic: the
+jitter stream is seeded by the policy, so a retried run is reproducible.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """``max_attempts`` total tries; sleeps grow ``base_delay * factor**i``
+    capped at ``max_delay``, each scaled by a ±``jitter`` fraction; the sum
+    of sleeps never exceeds ``budget`` seconds (a preempting host has a grace
+    window — better to fail over to the next checkpoint than to burn it
+    retrying)."""
+
+    max_attempts: int = 4
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    factor: float = 2.0
+    jitter: float = 0.5
+    budget: float = 30.0
+    retry_on: tuple = (OSError,)
+    seed: int = 0
+
+    def delays(self) -> "list[float]":
+        """The full (pre-budget) backoff schedule, for logging/tests."""
+        rng = random.Random(self.seed)
+        out = []
+        for i in range(self.max_attempts - 1):
+            d = min(self.max_delay, self.base_delay * self.factor ** i)
+            out.append(d * (1.0 + self.jitter * (2.0 * rng.random() - 1.0)))
+        return out
+
+
+def retry_call(
+    fn: Callable[..., Any],
+    *args: Any,
+    policy: RetryPolicy = RetryPolicy(),
+    on_retry: Callable[[dict], None] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs: Any,
+) -> Any:
+    """Call ``fn`` with retries per ``policy``.
+
+    Only ``policy.retry_on`` exceptions are retried — anything else (and a
+    :class:`~cst_captioning_tpu.resilience.chaos.SimulatedKill`, which is a
+    ``BaseException``) propagates immediately. ``on_retry`` receives a
+    structured dict per retry, ready for ``EventLogger.log(**info)``.
+    """
+    delays = policy.delays()
+    slept = 0.0
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            if attempt >= len(delays):
+                raise
+            delay = delays[attempt]
+            if slept + delay > policy.budget:
+                raise
+            if on_retry is not None:
+                on_retry({
+                    "attempt": attempt + 1,
+                    "delay": round(delay, 4),
+                    "error": type(e).__name__,
+                    "detail": str(e),
+                })
+            sleep(delay)
+            slept += delay
+    raise AssertionError("unreachable")  # pragma: no cover

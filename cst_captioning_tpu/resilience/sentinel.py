@@ -1,0 +1,127 @@
+"""Host-side divergence sentinel over the step loops.
+
+The on-device guard (:mod:`resilience.guard`) already *excludes* a non-finite
+update; the sentinel is the policy layer on top: it buffers each step's
+``(loss, nonfinite)`` device scalars and reads them back in batched chunks
+(one ``device_get`` per flush — never a per-step sync, per graftlint GL001),
+then
+
+- logs every divergence as a structured ``divergence`` event,
+- under ``policy="skip_batch"`` carries on (the guard did the work),
+- under ``policy="rollback"`` raises :class:`RollbackRequested` so the
+  trainer restores the last-good checkpoint and re-randomizes the data order,
+- under ``policy="abort"`` raises :class:`TrainingDiverged`.
+
+Loss *spikes* (finite but ``spike_factor``× the recent median) are detected
+on the same readback. A spiked update is already applied by the time the
+host sees it, so under ``skip_batch`` a spike is logged but not acted on;
+``rollback``/``abort`` treat it like a NaN.
+
+``check_every=None`` defers all checks to explicit :meth:`flush` calls (the
+trainer flushes at epoch ends and before checkpoint saves) — zero extra
+syncs for the default ``skip_batch`` policy. ``rollback``/``abort`` set a
+mid-epoch cadence so a diverged run stops within ``check_every`` steps.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+from collections import deque
+from typing import Any, Callable
+
+import jax
+
+POLICIES = ("off", "skip_batch", "rollback", "abort")
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised under ``policy="abort"`` or when the rollback budget runs out."""
+
+
+class RollbackRequested(RuntimeError):
+    """Control-flow escape: the trainer catches this and restores the
+    last-good checkpoint with a re-randomized data order."""
+
+    def __init__(self, message: str, step: int = -1, kind: str = ""):
+        super().__init__(message)
+        self.step = step
+        self.kind = kind
+
+
+class DivergenceSentinel:
+    def __init__(
+        self,
+        policy: str = "skip_batch",
+        phase: str = "xe",
+        log: Callable[..., None] | None = None,
+        spike_factor: float = 0.0,
+        window: int = 32,
+        warmup: int = 8,
+        check_every: int | None = None,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown divergence policy {policy!r}")
+        self.policy = policy
+        self.phase = phase
+        self.log = log or (lambda event, **fields: None)
+        self.spike_factor = spike_factor
+        self.warmup = warmup
+        self.check_every = check_every
+        self._recent: deque[float] = deque(maxlen=window)
+        self._buf: list[tuple[int, Any, Any]] = []
+        self.skipped = 0
+
+    def push(self, step: int, loss: Any, nonfinite: Any = None) -> None:
+        """Record one step's (device) scalars; flushes on the cadence."""
+        if self.policy == "off":
+            return
+        self._buf.append((step, loss, nonfinite))
+        if self.check_every is not None and len(self._buf) >= self.check_every:
+            self.flush()
+
+    def flush(self) -> None:
+        """ONE host readback for everything buffered, then per-step checks."""
+        if not self._buf:
+            return
+        buf, self._buf = self._buf, []
+        for step, loss, nonfinite in jax.device_get(buf):
+            self._check(int(step), float(loss), nonfinite)
+
+    def reset(self) -> None:
+        """Drop buffered scalars and spike history (rollback/epoch restart)."""
+        self._buf.clear()
+        self._recent.clear()
+
+    # ---- internals ----------------------------------------------------------
+
+    def _check(self, step: int, loss: float, nonfinite: Any) -> None:
+        bad = bool(nonfinite) if nonfinite is not None else False
+        if bad or not math.isfinite(loss):
+            self._diverged(step, loss, "nonfinite")
+            return
+        if (
+            self.spike_factor
+            and len(self._recent) >= self.warmup
+            and loss > self.spike_factor * statistics.median(self._recent)
+        ):
+            self._diverged(step, loss, "spike")
+            return
+        self._recent.append(loss)
+
+    def _diverged(self, step: int, loss: float, kind: str) -> None:
+        # skip_batch cannot un-apply a finite-but-spiked update — log only
+        action = self.policy
+        if kind == "spike" and self.policy == "skip_batch":
+            action = "logged"
+        self.log(
+            "divergence",
+            phase=self.phase, step=step, loss=loss, kind=kind, action=action,
+        )
+        if self.policy == "skip_batch":
+            self.skipped += kind == "nonfinite"
+            return
+        msg = f"{self.phase} step {step}: {kind} loss {loss!r}"
+        if self.policy == "rollback":
+            raise RollbackRequested(msg, step=step, kind=kind)
+        raise TrainingDiverged(msg)

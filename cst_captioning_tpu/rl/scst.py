@@ -34,8 +34,11 @@ from cst_captioning_tpu.decoding import greedy_decode, sample_decode
 from cst_captioning_tpu.decoding.common import mask_from_tokens
 from cst_captioning_tpu.losses import reinforce_loss, sequence_log_probs
 from cst_captioning_tpu.models.captioner import CaptionModel
+from cst_captioning_tpu.resilience import chaos
+from cst_captioning_tpu.resilience.retry import RetryPolicy, retry_call
 from cst_captioning_tpu.rl.rewards import RewardComputer, scb_baseline
 from cst_captioning_tpu.train.state import TrainState
+from cst_captioning_tpu.train.steps import _apply
 
 
 def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
@@ -222,7 +225,8 @@ def _chunked_loss_grads(model, params, feats, masks, samples, advantage,
     return num, den, g_sum
 
 
-def make_rl_update(model, chunks: int = 1, donate: bool = False) -> Callable:
+def make_rl_update(model, chunks: int = 1, donate: bool = False,
+                   guard: bool = False) -> Callable:
     """Jitted: (state, feats, masks, samples [K,B,T], adv [K,B]) -> (state, metrics).
 
     ``chunks > 1`` accumulates gradients over slices of the rollout axis
@@ -230,7 +234,9 @@ def make_rl_update(model, chunks: int = 1, donate: bool = False) -> Callable:
     :func:`_chunked_loss_grads`). ``donate=True`` donates the input state's
     buffers (params + Adam moments update in place; the passed-in state is
     consumed — rebind, never reuse); off by default so exactness tests can
-    replay one state through several update variants.
+    replay one state through several update variants. ``guard=True``
+    suppresses non-finite updates on device (resilience/guard.py) and adds
+    a ``nonfinite`` metric.
     """
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
@@ -260,16 +266,16 @@ def make_rl_update(model, chunks: int = 1, donate: bool = False) -> Callable:
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
-        state = state.apply_gradients(grads)
-        return state, {"rl_loss": loss, "grad_norm": gnorm}
+        return _apply(state, grads, loss, gnorm, guard, key="rl_loss")
 
     return update
 
 
 def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
-                            chunks: int = 1, donate: bool = False) -> Callable:
+                            chunks: int = 1, donate: bool = False,
+                            guard: bool = False) -> Callable:
     """shard_map variant: batch axis sharded, exact global normalization.
-    ``chunks`` / ``donate`` exactly like :func:`make_rl_update`."""
+    ``chunks`` / ``donate`` / ``guard`` exactly like :func:`make_rl_update`."""
 
     def device_update(state, feats, masks, samples, advantage, valid):
         if chunks > 1:
@@ -300,8 +306,9 @@ def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
             grads_num,
         )
         gnorm = optax.global_norm(grads)
-        state = state.apply_gradients(grads)
-        return state, {"rl_loss": loss, "grad_norm": gnorm}
+        # psum'd grads/loss are device-invariant: the guarded select picks
+        # the same branch on every shard, so state stays replicated
+        return _apply(state, grads, loss, gnorm, guard, key="rl_loss")
 
     sharded = shard_map(
         device_update,
@@ -334,14 +341,23 @@ class SCSTTrainer:
         mesh: Mesh | None = None,
         max_len: int | None = None,
         donate: bool = False,
+        guard: bool = False,
+        retry: RetryPolicy | None = None,
+        on_event: Callable | None = None,
     ):
         """``donate=True`` makes the REINFORCE update consume its input state
         (buffer donation — see :func:`make_rl_update`); the production
-        Trainer/bench path enables it, tests that replay a state don't."""
+        Trainer/bench path enables it, tests that replay a state don't.
+        ``guard=True`` adds the on-device non-finite update guard.
+        ``retry`` is the backoff policy for the (host-side, fallible in
+        production) reward scorer; ``on_event(event, **fields)`` receives
+        ``reward_retry`` events (an EventLogger.log works as-is)."""
         self.model = model
         self.reward = reward
         self.cfg = cfg
         self.mesh = mesh
+        self.retry = retry or RetryPolicy()
+        self.on_event = on_event or (lambda event, **fields: None)
         # only the 'greedy' baseline consumes the greedy rollout: scb/none
         # skip its decode, host transfer, and reward scoring entirely (one
         # of the K+1 decoded rows per clip on the flagship config)
@@ -359,7 +375,8 @@ class SCSTTrainer:
                 data_axis="data", with_greedy=wg,
             )
             self.update = make_sp_rl_update(
-                spm, mesh, chunks=cfg.update_chunks, donate=donate
+                spm, mesh, chunks=cfg.update_chunks, donate=donate,
+                guard=guard,
             )
         elif mesh is not None:
             self.decode = make_parallel_rl_decode(
@@ -367,7 +384,8 @@ class SCSTTrainer:
                 with_greedy=wg,
             )
             self.update = make_parallel_rl_update(
-                model, mesh, chunks=cfg.update_chunks, donate=donate
+                model, mesh, chunks=cfg.update_chunks, donate=donate,
+                guard=guard,
             )
         else:
             self.decode = make_rl_decode(
@@ -375,16 +393,32 @@ class SCSTTrainer:
                 with_greedy=wg,
             )
             self.update = make_rl_update(
-                model, chunks=cfg.update_chunks, donate=donate
+                model, chunks=cfg.update_chunks, donate=donate, guard=guard
             )
 
     # ---- reward / advantage (host) ------------------------------------------
+
+    def _reward_call(self, video_ids, rows):
+        """The reward scorer behind jittered-backoff retries: in-process
+        numpy never fails, but the production deployment scores against a
+        service — transient failures are retried, not fatal (and the chaos
+        ``reward.call`` point lets tests inject both)."""
+
+        def call():
+            chaos.visit("reward.call")
+            return self.reward(video_ids, rows)
+
+        return retry_call(
+            call,
+            policy=self.retry,
+            on_retry=lambda info: self.on_event("reward_retry", **info),
+        )
 
     def _advantage(self, greedy, samples_np, video_ids, valid_np):
         """-> (advantage [K,B] np, metrics dict). Blocks on decode transfer."""
         K = self.cfg.num_rollouts
         B = samples_np.shape[1]
-        r_samples = self.reward(video_ids, samples_np.reshape(K * B, -1))
+        r_samples = self._reward_call(video_ids, samples_np.reshape(K * B, -1))
         r_kb = r_samples.reshape(K, B)
 
         if self.cfg.baseline == "greedy":
@@ -393,7 +427,7 @@ class SCSTTrainer:
                     "baseline='greedy' needs the greedy rollout; the decode "
                     "was built with with_greedy=False"
                 )
-            r_greedy = self.reward(video_ids, np.asarray(greedy))
+            r_greedy = self._reward_call(video_ids, np.asarray(greedy))
             baseline = np.broadcast_to(r_greedy[None, :], (K, B))
         elif self.cfg.baseline == "scb":
             baseline = scb_baseline(r_kb)
@@ -487,8 +521,14 @@ class SCSTTrainer:
     # ---- pipelined epoch ----------------------------------------------------
 
     def train_epoch(self, state: TrainState, batches, rng, on_step=None,
-                    pipelined: bool = True):
+                    pipelined: bool = True, should_stop=None):
         """SCST over an epoch of batches.
+
+        ``should_stop()`` (optional) is polled once per batch; when it turns
+        True the epoch stops consuming batches and the pipeline DRAINS —
+        every batch already decoded gets its update applied, so the returned
+        state corresponds to exactly ``len(metrics)`` completed steps (the
+        preemption-save path depends on this invariant).
 
         ``batches`` yields ``(feats, masks, video_ids, valid)`` with arrays
         already on device.
@@ -527,6 +567,8 @@ class SCSTTrainer:
 
         if not pipelined:
             for feats, masks, video_ids, valid in batches:
+                if should_stop is not None and should_stop():
+                    break
                 rng, srng = jax.random.split(rng)
                 state, m = self.train_step(
                     state, feats, masks, video_ids, srng, valid
@@ -537,6 +579,8 @@ class SCSTTrainer:
         scored = None     # _apply args: advantage ready, update not dispatched
         decoded = None    # _score args: decode dispatched, not yet scored
         for feats, masks, video_ids, valid in batches:
+            if should_stop is not None and should_stop():
+                break
             if scored is not None:
                 state, m = self._apply(state, *scored)
                 scored = None

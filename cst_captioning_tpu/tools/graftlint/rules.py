@@ -833,3 +833,64 @@ class TpuTestMarkerRule(Rule):
                     if _dotted(sub).endswith("mark.slow"):
                         return True
         return False
+
+
+# ---- GL009: silently swallowed broad exceptions -----------------------------
+
+@register
+class SwallowedExceptionRule(Rule):
+    id = "GL009"
+    name = "silent-exception-swallow"
+    severity = "warning"
+    rationale = (
+        "a bare `except Exception: pass/continue` in package code hides "
+        "corrupt checkpoints and I/O failures without a trace — log a "
+        "structured event (or narrow the exception type) before falling back"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # package code only: tests/benches swallow on purpose when asserting
+        # failure modes, and scripts print their own diagnostics
+        return ctx.relpath.startswith("cst_captioning_tpu/")
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not self._broad(handler.type):
+                    continue
+                if not all(self._silent(stmt) for stmt in handler.body):
+                    continue  # the handler logs/recovers — that's the fix
+                caught = (
+                    "bare except" if handler.type is None
+                    else _last(_dotted(handler.type)) or "Exception"
+                )
+                out.append(ctx.finding(
+                    self, handler,
+                    f"{caught} swallowed silently (body is only "
+                    "pass/continue): a corrupt checkpoint or failed I/O "
+                    "vanishes without a structured event — log which "
+                    "operation failed and why before falling back",
+                ))
+        return out
+
+    @classmethod
+    def _broad(cls, type_node) -> bool:
+        """True for ``except:``, ``except (Base)Exception``, or a tuple
+        containing one — narrow types are a deliberate contract."""
+        if type_node is None:
+            return True
+        if isinstance(type_node, ast.Tuple):
+            return any(cls._broad(elt) for elt in type_node.elts)
+        return _last(_dotted(type_node)) in ("Exception", "BaseException")
+
+    @staticmethod
+    def _silent(stmt: ast.stmt) -> bool:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            return True
+        # a lone string/ellipsis expression is documentation, not handling
+        return isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        )

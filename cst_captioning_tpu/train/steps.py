@@ -30,7 +30,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.losses import masked_cross_entropy
+from cst_captioning_tpu.resilience.guard import guarded_apply_gradients
 from cst_captioning_tpu.train.state import TrainState
+
+
+def _apply(state, grads, loss, gnorm, guard: bool, key: str = "loss"):
+    """Optionally-guarded update; metrics grow a ``nonfinite`` flag when
+    guarded (see resilience/guard.py — bit-identical on finite steps).
+    ``key`` names the loss metric ("loss" for XE steps, "rl_loss" for the
+    REINFORCE updates)."""
+    if not guard:
+        return state.apply_gradients(grads), {key: loss, "grad_norm": gnorm}
+    state, nonfinite = guarded_apply_gradients(state, grads, loss, gnorm)
+    return state, {key: loss, "grad_norm": gnorm, "nonfinite": nonfinite}
 
 
 def _local_loss_sums(model, params, feats, masks, labels, mask, weights,
@@ -49,7 +61,8 @@ def _local_loss_sums(model, params, feats, masks, labels, mask, weights,
     return num, den
 
 
-def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False):
+def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False,
+                 guard: bool = False):
     """Single-device jitted step: (state, batch arrays) -> (state, metrics).
 
     ``donate=True`` donates the input ``state`` buffers to the output state
@@ -57,6 +70,10 @@ def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False):
     free HBM headroom on the production path). The caller must then treat
     the passed-in state as consumed: rebind, never reuse. Off by default so
     exactness tests can replay one state through several step variants.
+
+    ``guard=True`` suppresses non-finite updates on device and adds a
+    ``nonfinite`` metric (resilience/guard.py); finite steps are bit-equal
+    to the unguarded program.
     """
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
@@ -71,16 +88,16 @@ def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False):
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
-        state = state.apply_gradients(grads)
-        return state, {"loss": loss, "grad_norm": gnorm}
+        return _apply(state, grads, loss, gnorm, guard)
 
     return step
 
 
 def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
-                          axis: str = "data", donate: bool = False):
+                          axis: str = "data", donate: bool = False,
+                          guard: bool = False):
     """shard_map data-parallel step, exact-equivalent to the fused batch.
-    ``donate``: see :func:`make_xe_step`."""
+    ``donate`` / ``guard``: see :func:`make_xe_step`."""
 
     def device_step(state: TrainState, feats, masks, labels, mask, weights):
         drng = jax.random.fold_in(
@@ -104,8 +121,9 @@ def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
         )
         loss = num_total / jnp.maximum(den_total, 1.0)
         gnorm = optax.global_norm(grads)
-        state = state.apply_gradients(grads)
-        return state, {"loss": loss, "grad_norm": gnorm}
+        # grads/loss are psum'd (device-invariant), so the guard's where()
+        # selects identically on every shard — state stays replicated
+        return _apply(state, grads, loss, gnorm, guard)
 
     sharded = shard_map(
         device_step,

@@ -7,12 +7,23 @@ CIDEr-D -> best/latest checkpoints -> optional resume -> XE->RL handoff.
 Device placement: with a multi-device mesh the step is the shard_map-parallel
 variant and batches are placed sharded; single device uses the plain jitted
 step. Host batch prep overlaps device compute via the prefetch thread.
+
+Resilience (resilience/ package): both phase loops run under a SIGTERM
+preemption handler (mid-epoch save recording the exact batch index, so a
+resumed run replays the *remainder* of the epoch — the epoch-keyed shuffle
+makes that bit-deterministic), a divergence sentinel with a configurable
+policy (``train.on_divergence``), optional ``train.ckpt_every_steps``
+mid-epoch ``step_*`` checkpoints with keep-last-K rotation, and chaos
+injection points (``xe.step``/``xe.batch``/``rl.step``/``rl.batch``) so the
+fault paths are testable.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
+import threading
 
 import jax
 import numpy as np
@@ -30,6 +41,13 @@ from cst_captioning_tpu.parallel import (
     sp_batch_shardings,
     sp_model,
 )
+from cst_captioning_tpu.resilience import chaos
+from cst_captioning_tpu.resilience.preempt import Preempted, PreemptionHandler
+from cst_captioning_tpu.resilience.sentinel import (
+    DivergenceSentinel,
+    RollbackRequested,
+    TrainingDiverged,
+)
 from cst_captioning_tpu.rl import RewardComputer, SCSTTrainer
 from cst_captioning_tpu.train import multihost
 from cst_captioning_tpu.train.mesh import batch_sharding, make_mesh, replicate
@@ -46,6 +64,10 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     "train.resume", "train.ckpt_dir", "train.profile_dir",
     "train.profile_steps", "train.debug_nans", "train.log_every_steps",
     "train.log_every",  # pre-rename snapshots carry the old field name
+    # resilience plumbing: save cadence/rotation/rollback budget change how a
+    # run survives faults, not what it computes (on_divergence/spike_factor
+    # DO alter numerics under faults, so those two stay drift-tracked)
+    "train.ckpt_every_steps", "train.keep_ckpts", "train.max_rollbacks",
     "eval.results_json",
 })
 
@@ -132,6 +154,10 @@ class Trainer:
         self.state = create_train_state(
             self.model, tx, (feats, masks, labels), seed=cfg.train.seed
         )
+        # the on-device finite-update guard rides with any active sentinel
+        # policy (bit-identical on finite steps; "off" restores the exact
+        # unguarded program)
+        self.guard = cfg.train.on_divergence != "off"
         if self.mesh is not None:
             self.state = replicate(self.mesh, self.state)
             if self.sp:
@@ -142,16 +168,17 @@ class Trainer:
                 # double-buffering — HBM headroom on the production path
                 self.xe_step = make_sp_xe_step(
                     sp_model(cfg.model), self.mesh, cfg.train.label_smoothing,
-                    data_axis="data", donate=True,
+                    data_axis="data", donate=True, guard=self.guard,
                 )
             else:
                 self.xe_step = make_parallel_xe_step(
                     self.model, self.mesh, cfg.train.label_smoothing,
-                    donate=True,
+                    donate=True, guard=self.guard,
                 )
         else:
             self.xe_step = make_xe_step(
-                self.model, cfg.train.label_smoothing, donate=True
+                self.model, cfg.train.label_smoothing, donate=True,
+                guard=self.guard,
             )
 
         if multihost.is_multiprocess():
@@ -163,10 +190,18 @@ class Trainer:
                 process_index=jax.process_index(),
                 devices=len(jax.devices()),
             )
-        self.ckpt = CheckpointManager(cfg.train.ckpt_dir, metric="CIDEr-D")
+        self.ckpt = CheckpointManager(
+            cfg.train.ckpt_dir, metric="CIDEr-D", keep=cfg.train.keep_ckpts,
+            log=self.log.log,
+        )
         self.epoch = 0        # global epoch counter (batch-order key, logging)
         self.xe_epochs = 0    # per-phase progress: epochs-field budgets are
         self.rl_epochs = 0    # TOTALS, so a resumed run finishes the remainder
+        # mid-epoch resume/rollback bookkeeping (resilience layer)
+        self._resume_batch = 0     # XE batches to skip in the next epoch
+        self._resume_rl_batch = 0  # RL batches to skip in the next epoch
+        self._rollbacks = 0        # divergence rollbacks consumed this run
+        self._rl_batcher: Batcher | None = None
         if cfg.train.resume:
             self._resume()
 
@@ -190,7 +225,10 @@ class Trainer:
         # resume=<dir>: explicit checkpoint directory (latest/best inside it)
         resume = self.cfg.train.resume
         src_dir = self.cfg.train.ckpt_dir if resume == "auto" else resume
-        mgr = self.ckpt if resume == "auto" else CheckpointManager(src_dir)
+        mgr = (
+            self.ckpt if resume == "auto"
+            else CheckpointManager(src_dir, log=self.log.log)
+        )
         restored = mgr.restore_latest(jax.device_get(self.state))
         if restored is None:
             self.log.log("resume_not_found", dir=src_dir)
@@ -208,6 +246,17 @@ class Trainer:
         # epoch index per *shuffled* (XE) epoch only — RL epochs run their own
         # video-mode batcher — so the XE count, not the global one, is the key
         self.batcher.epoch_index = self.xe_epochs
+        # mid-epoch checkpoint (preemption or step-interval save): the epoch
+        # counters above are COMPLETED epochs; batch_index says how far into
+        # the in-progress epoch the save happened, so the next phase call
+        # replays exactly the remainder under the same epoch-keyed shuffle
+        batch_index = int(infos.get("batch_index", 0))
+        phase = infos.get("phase", "")
+        if batch_index and phase == "xe":
+            self._resume_batch = batch_index
+        elif batch_index and phase == "rl":
+            self._resume_rl_batch = batch_index
+        self.batcher.salt = int(infos.get("data_salt", 0))
         # surface config drift between the checkpoint and this run
         saved_cfg = infos.get("config")
         if saved_cfg:
@@ -216,7 +265,10 @@ class Trainer:
             drift = _config_drift(saved_cfg, json.loads(self.cfg.to_json()))
             if drift:
                 self.log.log("resume_config_drift", fields=drift)
-        self.log.log("resume", dir=src_dir, step=int(state.step), epoch=self.epoch)
+        self.log.log(
+            "resume", dir=src_dir, step=int(state.step), epoch=self.epoch,
+            batch_index=batch_index, phase=phase or "epoch_end",
+        )
 
     def load_params_from(self, ckpt_dir: str, name: str = "best"):
         """XE -> RL handoff: params only, fresh optimizer (SURVEY.md §5)."""
@@ -238,10 +290,12 @@ class Trainer:
             return sp_batch_shardings(self.mesh, self.cfg.model)
         return batch_sharding(self.mesh)
 
-    def _device_batches(self, batcher: Batcher):
+    def _device_batches(self, batcher: Batcher, skip: int = 0,
+                        stop_event: threading.Event | None = None):
         shardings = self._batch_sharding()
 
         def transform(b):
+            b = chaos.visit("xe.batch", b)
             if shardings is None:
                 # valid rides along so wrap-padding rows get zero weight
                 return batch_arrays(b) + (
@@ -255,14 +309,19 @@ class Trainer:
             )
             return multihost.put_global(shardings, arrays)
 
+        # mid-epoch resume: drop the first ``skip`` batches of this epoch's
+        # (already deterministic) order before any transform/transfer
+        it = itertools.islice(batcher.epoch(), skip, None)
         yield from prefetch_to_device(
-            batcher.epoch(),
+            it,
             size=self.cfg.data.prefetch,
             transform=transform,
             place=shardings is None,
+            stop_event=stop_event,
         )
 
-    def _rl_device_batches(self, batcher: Batcher):
+    def _rl_device_batches(self, batcher: Batcher, skip: int = 0,
+                           stop_event: threading.Event | None = None):
         """Prefetched RL batches: arrays staged to device (sharded when a mesh
         is in play), video ids + valid mask staying host-side (this process's
         rows) for the reward."""
@@ -271,6 +330,7 @@ class Trainer:
             sharding = (sharding[0], sharding[1])  # (feats, masks) only
 
         def transform(b):
+            b = chaos.visit("rl.batch", b)
             if sharding is not None:
                 # numpy straight into the target sharding (single transfer)
                 feats, masks = multihost.put_global(
@@ -280,41 +340,173 @@ class Trainer:
                 feats, masks = jax.device_put((b.feats, b.feat_masks))
             return (feats, masks, b.video_ids, b.valid)
 
+        it = itertools.islice(batcher.epoch(shuffle=True), skip, None)
         yield from prefetch_to_device(
-            batcher.epoch(shuffle=True),
+            it,
             size=self.cfg.data.prefetch,
             transform=transform,
             place=False,
+            stop_event=stop_event,
         )
+
+    # ---- resilience helpers ------------------------------------------------
+
+    def _make_sentinel(self, phase: str) -> DivergenceSentinel:
+        """Policy/cadence from config: the default ``skip_batch`` policy
+        defers every readback to epoch ends / save points (zero extra host
+        syncs — the on-device guard already excluded the bad update);
+        ``rollback``/``abort`` buy mid-epoch detection for one amortized
+        device_get per 32 steps."""
+        cfg = self.cfg.train
+        return DivergenceSentinel(
+            policy=cfg.on_divergence,
+            phase=phase,
+            log=self.log.log,
+            spike_factor=cfg.spike_factor,
+            check_every=32 if cfg.on_divergence in ("rollback", "abort") else None,
+        )
+
+    def _ckpt_infos(self, phase: str = "", batch_index: int = 0,
+                    step_no: int | None = None) -> dict:
+        return {
+            "epoch": self.epoch,
+            "xe_epochs": self.xe_epochs,
+            "rl_epochs": self.rl_epochs,
+            "phase": phase,
+            "batch_index": batch_index,
+            "global_step": step_no,
+            "data_salt": self.batcher.salt,
+            "config": self.cfg.to_dict(),
+        }
+
+    def _save_step_ckpt(self, phase: str, step_no: int, batch_index: int) -> None:
+        """Mid-epoch checkpoint (step-interval or preemption-triggered):
+        records the exact batch index so resume replays the epoch remainder."""
+        if jax.process_index() == 0:
+            self.ckpt.save_step(
+                jax.device_get(self.state), step_no,
+                self._ckpt_infos(phase, batch_index, step_no),
+            )
+        self.log.log(
+            "ckpt_step", phase=phase, step=step_no, batch_index=batch_index,
+        )
+
+    def _preempt_save(self, phase: str, step_no: int, batch_index: int,
+                      sentinel: DivergenceSentinel) -> None:
+        """SIGTERM landed: flush pending divergence checks (never checkpoint
+        an update the sentinel would have rejected), save mid-epoch, make the
+        event log durable, and unwind via :class:`Preempted`."""
+        sentinel.flush()
+        self._save_step_ckpt(phase, step_no, batch_index)
+        self.log.log(
+            "preempt", phase=phase, step=step_no, batch_index=batch_index,
+        )
+        self.log.flush()
+        raise Preempted(
+            f"preempted at {phase} step {step_no} "
+            f"(epoch {self.epoch + 1}, batch {batch_index}); "
+            "checkpoint saved — rerun with train.resume='auto'"
+        )
+
+    def _apply_rollback(self, phase: str, err: RollbackRequested,
+                        sentinel: DivergenceSentinel) -> None:
+        """Divergence rollback: restore the newest verifiable checkpoint and
+        re-randomize the data order (salted epoch-keyed shuffle), so the
+        replayed epochs don't march straight back into the same poison batch
+        sequence. Budgeted by ``train.max_rollbacks``."""
+        self._rollbacks += 1
+        if self._rollbacks > self.cfg.train.max_rollbacks:
+            raise TrainingDiverged(
+                f"rollback budget exhausted ({self.cfg.train.max_rollbacks}) "
+                f"after {phase} divergence: {err}"
+            ) from err
+        restored = self.ckpt.restore_latest(jax.device_get(self.state))
+        if restored is None:
+            raise TrainingDiverged(
+                f"{phase} diverged with no checkpoint to roll back to: {err}"
+            ) from err
+        state, infos = restored
+        self.state = (
+            replicate(self.mesh, state) if self.mesh is not None else state
+        )
+        self.epoch = int(infos.get("epoch", 0))
+        self.xe_epochs = int(infos.get("xe_epochs", self.epoch))
+        self.rl_epochs = int(infos.get("rl_epochs", 0))
+        # the in-progress epoch restarts from batch 0 under the new salt (a
+        # mid-epoch checkpoint's batch_index indexes the OLD order — it no
+        # longer names the same batches, so it must not be replayed)
+        self._resume_batch = self._resume_rl_batch = 0
+        self.batcher.salt = self._rollbacks
+        if self._rl_batcher is not None:
+            self._rl_batcher.salt = self._rollbacks
+        sentinel.reset()
+        self.log.log(
+            "rollback",
+            phase=phase,
+            step=err.step,
+            kind=err.kind,
+            restored_step=infos.get("global_step"),
+            restored_epoch=self.epoch,
+            salt=self._rollbacks,
+        )
+
+    # ---- XE phase ----------------------------------------------------------
 
     def train_xe(self, epochs: int | None = None) -> float | None:
         """Cross-entropy (XE/WXE) phase; returns last validation CIDEr-D.
 
         ``epochs=None`` treats ``cfg.train.epochs`` as the phase TOTAL: a
-        resumed run trains only the remainder. An explicit ``epochs`` runs
-        exactly that many more.
+        resumed run trains only the remainder (including the remainder of a
+        mid-epoch preempted epoch). An explicit ``epochs`` runs exactly that
+        many more. Raises :class:`Preempted` after a SIGTERM-triggered save,
+        :class:`TrainingDiverged` under the abort policy / exhausted
+        rollback budget.
         """
         cfg = self.cfg
         if epochs is None:
             epochs = max(0, cfg.train.epochs - self.xe_epochs)
+        if epochs == 0:
+            return None
+        target = self.xe_epochs + epochs
         timer = StepTimer()
         profiler = StepProfiler(
             os.path.join(cfg.train.profile_dir, "xe") if cfg.train.profile_dir
             else "",
             cfg.train.profile_steps,
         )
+        sentinel = self._make_sentinel("xe")
         last_val = None
+        run = {"first_step": True}  # compile-step timer exclusion, phase-wide
+        with PreemptionHandler() as pre:
+            while self.xe_epochs < target:
+                try:
+                    last_val = self._xe_epoch(timer, profiler, sentinel, pre, run)
+                except RollbackRequested as e:
+                    self._apply_rollback("xe", e, sentinel)
+        return last_val
+
+    def _xe_epoch(self, timer, profiler, sentinel, pre, run) -> float | None:
+        """One XE epoch (possibly a resumed remainder): step loop, sentinel,
+        mid-epoch saves, epoch-end validation + checkpoint."""
+        cfg = self.cfg
         weighted = cfg.train.loss == "wxe"
-        first_step = True
         log_every = cfg.train.log_every_steps
-        # host-side step counter: reading int(self.state.step) in the loop
-        # would block on the just-dispatched update every step (graftlint
-        # GL001 — the RL phase's on_step counter already avoided this)
-        step_no = int(self.state.step)
-        for _ in range(epochs):
-            timer.reset()
-            losses = []
-            for arrays in self._device_batches(self.batcher):
+        ckpt_every = cfg.train.ckpt_every_steps
+        # pin the batch-order key: epochs 0..xe_epochs-1 are complete, this
+        # epoch replays/starts index xe_epochs (idempotent under rollback)
+        self.batcher.epoch_index = self.xe_epochs
+        skip = self._resume_batch
+        self._resume_batch = 0
+        batch_no = skip
+        # host-side step counter: reading int(self.state.step) per step in
+        # the loop would block on the just-dispatched update every step
+        step_no = int(self.state.step)  # graftlint: disable=GL001 (once per epoch)
+        timer.reset()
+        losses = []
+        stop = threading.Event()
+        try:
+            for arrays in self._device_batches(self.batcher, skip=skip,
+                                               stop_event=stop):
                 feats, masks, labels, mask, weights, valid = arrays
                 # invalid rows get zero weight -> excluded from loss + norm
                 weights = valid if not weighted else weights * valid
@@ -325,7 +517,9 @@ class Trainer:
                 # (graftlint GL001); the epoch summary reads them all back
                 # in one device_get
                 losses.append(m["loss"])
+                sentinel.push(step_no + 1, m["loss"], m.get("nonfinite"))
                 step_no += 1
+                batch_no += 1
                 if log_every and step_no % log_every == 0:
                     # per-step event: a mid-epoch divergence (NaN, grad blowup)
                     # is locatable from the log alone (SURVEY.md §5); the
@@ -339,29 +533,54 @@ class Trainer:
                         grad_norm=float(m["grad_norm"]),
                     )
                 profiler.tick()
-                if first_step:
+                if run["first_step"]:
                     # exclude jit-compile time from the throughput meter
-                    first_step = False
+                    run["first_step"] = False
                     timer.reset()
                 else:
                     timer.tick(cfg.data.batch_size)
-            profiler.stop()
-            self.epoch += 1
-            self.xe_epochs += 1
-            self.log.log(
-                "xe_epoch",
-                epoch=self.epoch,
-                # ONE readback for the whole epoch's loss scalars
-                loss=float(np.mean(jax.device_get(losses))),  # graftlint: disable=GL001 (once per epoch)
-                clips_per_sec=timer.clips_per_sec,
-            )
-            last_val = self._validate_and_checkpoint()
-        return last_val
+                chaos.visit("xe.step")
+                if pre.requested:
+                    self._preempt_save("xe", step_no, batch_no, sentinel)
+                if ckpt_every and step_no % ckpt_every == 0:
+                    sentinel.flush()  # never save an update the policy rejects
+                    self._save_step_ckpt("xe", step_no, batch_no)
+        finally:
+            stop.set()
+        profiler.stop()
+        # a SIGTERM that lands between the last step and here must not let
+        # the epoch counters advance past the state actually saved
+        if pre.requested:
+            self._preempt_save("xe", step_no, batch_no, sentinel)
+        sentinel.flush()
+        self.epoch += 1
+        self.xe_epochs += 1
+        vals = np.asarray(jax.device_get(losses), np.float64)  # graftlint: disable=GL001 (once per epoch)
+        vals = vals[np.isfinite(vals)]  # guard-skipped steps carry NaN losses
+        self.log.log(
+            "xe_epoch",
+            epoch=self.epoch,
+            # ONE readback for the whole epoch's loss scalars
+            loss=float(vals.mean()) if vals.size else float("nan"),
+            clips_per_sec=timer.clips_per_sec,
+        )
+        return self._validate_and_checkpoint(step_no)
 
     def train_rl(self, epochs: int | None = None) -> float | None:
         """CST/RL phase (SCST or consensus-CST per cfg.rl).
 
         ``epochs=None``: ``cfg.rl.epochs`` is the phase TOTAL (see train_xe).
+
+        Resilience mirrors the XE loop: divergence sentinel on every update,
+        SIGTERM stops the epoch at the next batch boundary (the pipeline
+        drains, so the saved state matches exactly ``batch_index`` completed
+        steps). A mid-epoch resume replays the remainder of the epoch: with
+        ``rl.pipelined=False`` that is bit-identical to the uninterrupted
+        run; the pipelined loop re-decodes the seam batch against params one
+        update fresher than the uninterrupted schedule would have (the
+        decode staleness is the pipeline's documented property — see
+        SCSTTrainer.train_epoch), after which the streams re-converge
+        structurally (same rng, same batches).
         """
         cfg = self.cfg
         if epochs is None:
@@ -408,7 +627,8 @@ class Trainer:
         )
         scst = SCSTTrainer(
             self.model, reward, cfg.rl, mesh=self.mesh,
-            max_len=cfg.model.max_len, donate=True,
+            max_len=cfg.model.max_len, donate=True, guard=self.guard,
+            on_event=self.log.log,
         )
         rl_batcher = Batcher(
             self.train_ds,
@@ -418,79 +638,133 @@ class Trainer:
             seed=cfg.data.shuffle_seed,
             host_shard=multihost.host_shard() if self.use_mesh else (0, 1),
         )
-        # keyed off the global epoch so a resumed RL phase replays the same
-        # per-epoch batch order as an uninterrupted run
-        rl_batcher.epoch_index = self.epoch
-        # per-epoch sampling rng is FOLDED from the global epoch, not drawn
-        # from a running split chain, so a resumed phase continues the stream
-        # (epoch k uses fold_in(base, k) whether or not the process restarted)
-        base_rng = jax.random.key(cfg.train.seed + 1)
+        rl_batcher.salt = self.batcher.salt
+        self._rl_batcher = rl_batcher
+        target = self.rl_epochs + epochs
         timer = StepTimer()
         profiler = StepProfiler(
             os.path.join(cfg.train.profile_dir, "rl") if cfg.train.profile_dir
             else "",
             cfg.train.profile_steps,
         )
+        sentinel = self._make_sentinel("rl")
         last_val = None
+        run = {"first_step": True}
+        try:
+            with PreemptionHandler() as pre:
+                while self.rl_epochs < target:
+                    try:
+                        last_val = self._rl_epoch(
+                            scst, rl_batcher, timer, profiler, sentinel, pre,
+                            run,
+                        )
+                    except RollbackRequested as e:
+                        self._apply_rollback("rl", e, sentinel)
+        finally:
+            self._rl_batcher = None
+        return last_val
+
+    def _rl_epoch(self, scst, rl_batcher, timer, profiler, sentinel, pre,
+                  run) -> float | None:
+        """One RL epoch (possibly a resumed remainder)."""
+        cfg = self.cfg
         log_every = cfg.train.log_every_steps
-        step_counter = {"step": int(self.state.step)}
-        for _ in range(epochs):
-            timer.reset()
-            rewards = []
-            valid_rows = []
+        # keyed off the global epoch so a resumed RL phase replays the same
+        # per-epoch batch order as an uninterrupted run (pinned per epoch so
+        # a rollback replay re-keys identically)
+        rl_batcher.epoch_index = self.epoch
+        skip = self._resume_rl_batch
+        self._resume_rl_batch = 0
+        # per-epoch sampling rng is FOLDED from the global epoch, not drawn
+        # from a running split chain, so a resumed phase continues the stream
+        # (epoch k uses fold_in(base, k) whether or not the process
+        # restarted); a rollback salt re-randomizes it together with the
+        # batch order
+        base_rng = jax.random.key(cfg.train.seed + 1)
+        if self.batcher.salt:
+            base_rng = jax.random.fold_in(base_rng, self.batcher.salt)
+        ep_rng = jax.random.fold_in(base_rng, self.epoch)
+        # mid-epoch resume: advance the per-batch split chain past the
+        # ``skip`` batches the checkpoint already trained on
+        for _ in range(skip):
+            ep_rng = jax.random.split(ep_rng)[0]
+        step_counter = {"step": int(self.state.step)}  # graftlint: disable=GL001 (once per epoch)
+        batch_counter = {"n": skip}
+        timer.reset()
+        rewards = []
+        valid_rows = []
 
-            def on_step(m):
-                rewards.append(m["reward_mean"])
-                valid_rows.append(m["valid_rows"])
-                step_counter["step"] += 1
-                if log_every and step_counter["step"] % log_every == 0:
-                    self.log.log(
-                        "rl_step",
-                        phase="rl",
-                        step=step_counter["step"],
-                        epoch=self.epoch + 1,
-                        reward=float(m["reward_mean"]),
-                        rl_loss=float(m["rl_loss"]),
-                        grad_norm=float(m["grad_norm"]),
-                    )
-                profiler.tick()
-                if len(rewards) == 1:
-                    timer.reset()  # exclude jit-compile time of the first step
-                else:
-                    timer.tick(cfg.data.batch_size)
+        def on_step(m):
+            rewards.append(m["reward_mean"])
+            valid_rows.append(m["valid_rows"])
+            step_counter["step"] += 1
+            batch_counter["n"] += 1
+            sentinel.push(
+                step_counter["step"], m["rl_loss"], m.get("nonfinite")
+            )
+            if log_every and step_counter["step"] % log_every == 0:
+                self.log.log(
+                    "rl_step",
+                    phase="rl",
+                    step=step_counter["step"],
+                    epoch=self.epoch + 1,
+                    reward=float(m["reward_mean"]),
+                    rl_loss=float(m["rl_loss"]),
+                    grad_norm=float(m["grad_norm"]),
+                )
+            profiler.tick()
+            if run["first_step"]:
+                run["first_step"] = False
+                timer.reset()  # exclude jit-compile time of the first step
+            else:
+                timer.tick(cfg.data.batch_size)
+            chaos.visit("rl.step")
 
-            # pipelined epoch (rl.pipelined, default): host reward for batch i
-            # overlaps device update i-1 + decode i+1; batches are prefetched
-            # to device by a host thread. pipelined=False: strict on-policy
-            ep_rng = jax.random.fold_in(base_rng, self.epoch)
+        # pipelined epoch (rl.pipelined, default): host reward for batch i
+        # overlaps device update i-1 + decode i+1; batches are prefetched
+        # to device by a host thread. pipelined=False: strict on-policy.
+        # should_stop: a SIGTERM stops consuming at the next batch boundary
+        # and the pipeline drains, so state == batch_counter steps exactly
+        stop = threading.Event()
+        try:
             self.state, _ = scst.train_epoch(
                 self.state,
-                self._rl_device_batches(rl_batcher),
+                self._rl_device_batches(rl_batcher, skip=skip,
+                                        stop_event=stop),
                 ep_rng,
                 on_step=on_step,
                 pipelined=cfg.rl.pipelined,
+                should_stop=lambda: pre.requested,
             )
-            profiler.stop()
-            self.epoch += 1
-            self.rl_epochs += 1
-            self.log.log(
-                "rl_epoch",
-                epoch=self.epoch,
-                # per-step rewards are scored on this host's rows only; weight
-                # by valid rows (wrap-padded final batches have fewer) and
-                # reduce exactly across processes
-                reward=multihost.global_weighted_mean(
-                    # host floats from the reward computer — no device sync
-                    float(np.dot(rewards, valid_rows)), float(np.sum(valid_rows))  # graftlint: disable=GL001 (once per epoch, host values)
-                ),
-                clips_per_sec=timer.clips_per_sec,
+        finally:
+            stop.set()
+        profiler.stop()
+        if pre.requested:
+            self._preempt_save(
+                "rl", step_counter["step"], batch_counter["n"], sentinel
             )
-            last_val = self._validate_and_checkpoint()
-        return last_val
+        sentinel.flush()
+        self.epoch += 1
+        self.rl_epochs += 1
+        n_valid = float(np.sum(valid_rows)) if valid_rows else 0.0
+        self.log.log(
+            "rl_epoch",
+            epoch=self.epoch,
+            # per-step rewards are scored on this host's rows only; weight
+            # by valid rows (wrap-padded final batches have fewer) and
+            # reduce exactly across processes
+            reward=multihost.global_weighted_mean(
+                # host floats from the reward computer — no device sync
+                float(np.dot(rewards, valid_rows)) if valid_rows else 0.0,
+                n_valid,
+            ),
+            clips_per_sec=timer.clips_per_sec,
+        )
+        return self._validate_and_checkpoint(step_counter["step"])
 
     # ---- validation --------------------------------------------------------
 
-    def _validate_and_checkpoint(self) -> float | None:
+    def _validate_and_checkpoint(self, step_no: int | None = None) -> float | None:
         value = None
         if self.validator is not None and (
             self.epoch % self.cfg.train.eval_every_epochs == 0
@@ -507,13 +781,9 @@ class Trainer:
             jax.device_get(self.state),
             value,
             # full config snapshot: the reference's `infos` pickle carried the
-            # whole opt namespace (SURVEY.md §5 checkpoint row)
-            infos={
-                "epoch": self.epoch,
-                "xe_epochs": self.xe_epochs,
-                "rl_epochs": self.rl_epochs,
-                "config": self.cfg.to_dict(),
-            },
+            # whole opt namespace (SURVEY.md §5 checkpoint row); global_step/
+            # phase/batch_index/data_salt feed mid-epoch resume ordering
+            infos=self._ckpt_infos(step_no=step_no),
         )
         if is_best:
             self.log.log("new_best", epoch=self.epoch, cider_d=value)

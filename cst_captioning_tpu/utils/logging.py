@@ -7,6 +7,7 @@ are machine-parseable, plus a human-readable console echo.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import sys
@@ -15,11 +16,24 @@ from typing import Any, IO
 
 
 class EventLogger:
+    """JSONL event sink, safe to lose power on.
+
+    Line-buffered writes, an :func:`atexit`-registered close (so an
+    interpreter teardown — including one triggered by SIGTERM's default
+    disposition — never strands buffered events), an explicit fsync'ing
+    :meth:`flush` for preemption-save paths, and a context-manager protocol
+    that records a final ``crash`` event (exception type + message) when the
+    governed block dies on an unhandled error."""
+
     def __init__(self, path: str = "", echo: bool = True):
         self._fh: IO | None = None
+        self._atexit_close = None
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._fh = open(path, "a", buffering=1)
+            # bound method in a local so unregister() matches register()
+            self._atexit_close = self.close
+            atexit.register(self._atexit_close)
         self.echo = echo
 
     def log(self, event: str, **fields: Any) -> None:
@@ -33,10 +47,33 @@ class EventLogger:
             )
             print(f"[{event}] {kv}", file=sys.stderr)
 
-    def close(self) -> None:
+    def flush(self) -> None:
+        """Push buffered events to the OS and fsync them to disk — called on
+        the preemption path, where the process dies moments later."""
         if self._fh:
+            self._fh.flush()
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError:
+                pass  # non-seekable sink (pipe/pty): flush() already did it
+
+    def close(self) -> None:
+        if self._atexit_close is not None:
+            atexit.unregister(self._atexit_close)
+            self._atexit_close = None
+        if self._fh:
+            self.flush()
             self._fh.close()
             self._fh = None
+
+    def __enter__(self) -> "EventLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.log("crash", error=exc_type.__name__, detail=str(exc))
+        self.close()
+        return False
 
 
 class StepTimer:

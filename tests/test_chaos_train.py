@@ -1,0 +1,304 @@
+"""Chaos-driven trainer tests: the resilience acceptance criteria.
+
+Every scenario here is a seeded :class:`FaultPlan` driving the injection
+points compiled into the trainer/ckpt/rl hot paths (resilience/chaos.py):
+
+- SIGTERM mid-epoch -> mid-epoch save -> resume -> bit-identical to the
+  uninterrupted run (params AND per-step losses);
+- NaN-poisoned batch under ``skip_batch`` -> epoch completes with the batch
+  excluded (step counter excludes it, params stay finite);
+- ``rollback`` -> last-good checkpoint restored, data order re-salted, run
+  completes; ``abort`` -> TrainingDiverged;
+- truncated ``state.msgpack`` -> manifest checksum detects it, the previous
+  checkpoint is restored, a ``ckpt_corrupt`` event is logged;
+- transient reward-scorer failures -> retried with logged ``reward_retry``.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config.config import (
+    DataConfig,
+    EvalConfig,
+    ExperimentConfig,
+    ModelConfig,
+    RLConfig,
+    TrainConfig,
+)
+from cst_captioning_tpu.data import CaptionDataset, make_synthetic_dataset
+from cst_captioning_tpu.resilience import Fault, FaultPlan, Preempted, TrainingDiverged
+from cst_captioning_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def synth_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("chaossynth")
+    return make_synthetic_dataset(
+        str(out),
+        num_videos=12,
+        num_topics=3,
+        vocab_words=20,
+        modalities={"resnet": 16},
+        max_frames=4,
+        seed=5,
+    )
+
+
+@pytest.fixture(scope="module")
+def datasets(synth_dir):
+    train = CaptionDataset(
+        synth_dir["info_json"], {"resnet": synth_dir["resnet"]}, "train", 4
+    )
+    val = CaptionDataset(
+        synth_dir["info_json"], {"resnet": synth_dir["resnet"]}, "val", 4
+    )
+    return train, val
+
+
+def make_cfg(ckpt_dir: str, vocab_size: int, **train_kw) -> ExperimentConfig:
+    train_kw.setdefault("eval_every_epochs", 100)
+    train_kw.setdefault("epochs", 2)
+    return ExperimentConfig(
+        name="chaos",
+        model=ModelConfig(
+            vocab_size=vocab_size,
+            modalities=(("resnet", 16),),
+            d_embed=16,
+            d_hidden=16,
+            d_att=8,
+            encoder="temporal_attention",
+            dropout=0.0,
+            max_len=8,
+            max_frames=4,
+            dtype="float32",
+        ),
+        data=DataConfig(batch_size=8, seq_per_vid=2),
+        train=TrainConfig(
+            lr=5e-3, grad_clip=5.0, ckpt_dir=ckpt_dir, seed=0,
+            log_every_steps=1, **train_kw,
+        ),
+        rl=RLConfig(
+            enabled=True, num_rollouts=2, lr=1e-3, epochs=2,
+            baseline="greedy", pipelined=False,
+        ),
+        eval=EvalConfig(beam_size=1, max_len=8),
+    )
+
+
+def events_of(log_path, kind):
+    return [
+        e for e in (json.loads(l) for l in open(log_path))
+        if e["event"] == kind
+    ]
+
+
+def params_equal(a, b):
+    for x, y in zip(
+        jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# 12 videos x seq_per_vid=2 = 24 rows / batch_size 8 = 3 XE batches per epoch
+STEPS_PER_EPOCH = 3
+
+
+def test_sigterm_mid_epoch_resume_is_bit_identical(datasets, tmp_path_factory):
+    """ISSUE acceptance #1: kill mid-epoch via chaos plan, resume, per-step
+    losses and final params match the uninterrupted run bit-for-bit."""
+    train_ds, _ = datasets
+    d1 = str(tmp_path_factory.mktemp("straight"))
+    d2 = str(tmp_path_factory.mktemp("preempted"))
+
+    cfg1 = make_cfg(d1, len(train_ds.vocab))
+    tr_straight = Trainer(cfg1, train_ds, None, log_path=d1 + "/ev.jsonl",
+                          use_mesh=False)
+    tr_straight.train_xe()
+
+    # SIGTERM lands after step 5 = batch 2 of epoch 2 (0-based visit 4)
+    cfg2 = make_cfg(d2, len(train_ds.vocab))
+    tr_kill = Trainer(cfg2, train_ds, None, log_path=d2 + "/ev.jsonl",
+                      use_mesh=False)
+    plan = FaultPlan([Fault("xe.step", "preempt", at=STEPS_PER_EPOCH + 1)])
+    with plan.activate():
+        with pytest.raises(Preempted):
+            tr_kill.train_xe()
+    assert plan.fired and plan.fired[0]["kind"] == "preempt"
+    assert events_of(d2 + "/ev.jsonl", "preempt")[0]["batch_index"] == 2
+    # the mid-epoch checkpoint recorded the exact position
+    step_dirs = [n for n in os.listdir(d2) if n.startswith("step_")]
+    assert len(step_dirs) == 1
+    infos = json.load(open(os.path.join(d2, step_dirs[0], "infos.json")))
+    assert infos["phase"] == "xe" and infos["batch_index"] == 2
+    assert infos["xe_epochs"] == 1  # one COMPLETED epoch
+
+    # rerun the same command with resume: replays the epoch remainder
+    cfg_resume = dataclasses.replace(
+        cfg2, train=dataclasses.replace(cfg2.train, resume="auto")
+    )
+    tr_res = Trainer(cfg_resume, train_ds, None, log_path=d2 + "/ev2.jsonl",
+                     use_mesh=False)
+    assert tr_res._resume_batch == 2
+    tr_res.train_xe()
+
+    assert tr_res.xe_epochs == tr_straight.xe_epochs == 2
+    assert int(tr_res.state.step) == int(tr_straight.state.step)
+    params_equal(tr_straight.state.params, tr_res.state.params)
+
+    # per-step losses: pre-kill steps 1-5 + resumed step 6 == straight 1-6
+    straight = {
+        e["step"]: e["loss"] for e in events_of(d1 + "/ev.jsonl", "xe_step")
+    }
+    chaos_run = {
+        e["step"]: e["loss"] for e in events_of(d2 + "/ev.jsonl", "xe_step")
+    }
+    chaos_run.update({
+        e["step"]: e["loss"] for e in events_of(d2 + "/ev2.jsonl", "xe_step")
+    })
+    assert chaos_run == straight  # bit-for-bit (json round-trips repr floats)
+
+
+def test_nan_batch_skipped_epoch_completes(datasets, tmp_path_factory):
+    """ISSUE acceptance #2: a NaN-poisoned batch under skip_batch completes
+    the epoch with the batch excluded."""
+    train_ds, _ = datasets
+    d = str(tmp_path_factory.mktemp("nanskip"))
+    cfg = make_cfg(d, len(train_ds.vocab), epochs=1)
+    tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl", use_mesh=False)
+    plan = FaultPlan([Fault("xe.batch", "nan", at=1)])
+    with plan.activate():
+        tr.train_xe()
+    assert tr.xe_epochs == 1
+    # the poisoned batch is EXCLUDED: the device-side guard suppressed its
+    # update, so the step counter advanced for 2 of the 3 batches only
+    assert int(tr.state.step) == STEPS_PER_EPOCH - 1
+    for leaf in jax.tree_util.tree_leaves(tr.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    div = events_of(d + "/ev.jsonl", "divergence")
+    assert len(div) == 1
+    assert div[0]["kind"] == "nonfinite" and div[0]["action"] == "skip_batch"
+    # the epoch summary excludes the NaN loss scalar too
+    (ep,) = events_of(d + "/ev.jsonl", "xe_epoch")
+    assert np.isfinite(ep["loss"])
+
+
+def test_nan_batch_abort_policy_raises(datasets, tmp_path_factory):
+    train_ds, _ = datasets
+    d = str(tmp_path_factory.mktemp("nanabort"))
+    cfg = make_cfg(d, len(train_ds.vocab), epochs=1, on_divergence="abort")
+    tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl", use_mesh=False)
+    with FaultPlan([Fault("xe.batch", "nan", at=1)]).activate():
+        with pytest.raises(TrainingDiverged):
+            tr.train_xe()
+
+
+def test_nan_batch_rollback_restores_and_resalts(datasets, tmp_path_factory):
+    """Divergence in epoch 2 under rollback: restore the epoch-1 checkpoint,
+    re-randomize the order (salt), and still finish the full budget."""
+    train_ds, _ = datasets
+    d = str(tmp_path_factory.mktemp("nanroll"))
+    cfg = make_cfg(d, len(train_ds.vocab), on_divergence="rollback")
+    tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl", use_mesh=False)
+    # poison one batch of epoch 2 (visits 3..5); the replayed (salted) epoch
+    # uses later visit indices, so the poison does not re-fire
+    with FaultPlan([Fault("xe.batch", "nan", at=STEPS_PER_EPOCH + 1)]).activate():
+        tr.train_xe()
+    assert tr.xe_epochs == 2 and tr.epoch == 2
+    assert tr.batcher.salt == 1
+    (rb,) = events_of(d + "/ev.jsonl", "rollback")
+    assert rb["restored_epoch"] == 1 and rb["salt"] == 1
+    for leaf in jax.tree_util.tree_leaves(tr.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_truncated_checkpoint_resume_falls_back(datasets, tmp_path_factory):
+    """ISSUE acceptance #3: a truncated state.msgpack is caught by the
+    manifest checksum; resume logs ckpt_corrupt and restores the previous
+    checkpoint."""
+    train_ds, val_ds = datasets
+    d = str(tmp_path_factory.mktemp("trunc"))
+    cfg = make_cfg(d, len(train_ds.vocab), epochs=1, eval_every_epochs=1)
+    Trainer(cfg, train_ds, val_ds, use_mesh=False).train_xe()  # latest + best
+    sp = os.path.join(d, "latest", "state.msgpack")
+    with open(sp, "r+b") as f:
+        f.truncate(os.path.getsize(sp) // 2)
+
+    cfg_resume = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, resume="auto")
+    )
+    tr = Trainer(cfg_resume, train_ds, None, log_path=d + "/ev.jsonl",
+                 use_mesh=False)
+    assert tr.epoch == 1  # restored (from 'best') despite the corrupt latest
+    (ev,) = events_of(d + "/ev.jsonl", "ckpt_corrupt")
+    assert ev["name"] == "latest"
+    assert ev["error"] == "CorruptCheckpointError"
+    assert "state.msgpack" in ev["detail"]
+    assert events_of(d + "/ev.jsonl", "resume")
+
+
+def test_step_interval_checkpoints_rotate(datasets, tmp_path_factory):
+    train_ds, _ = datasets
+    d = str(tmp_path_factory.mktemp("interval"))
+    cfg = make_cfg(
+        d, len(train_ds.vocab), ckpt_every_steps=2, keep_ckpts=2,
+    )
+    tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl", use_mesh=False)
+    tr.train_xe()  # 6 steps -> saves at 2, 4, 6; rotation keeps the last 2
+    assert [s for s, _ in tr.ckpt.step_checkpoints()] == [4, 6]
+    saves = events_of(d + "/ev.jsonl", "ckpt_step")
+    assert [e["step"] for e in saves] == [2, 4, 6]
+    # batch_index recorded relative to the epoch (3 steps per epoch)
+    assert [e["batch_index"] for e in saves] == [2, 1, 3]
+
+
+def test_rl_preemption_strict_resume_is_bit_identical(datasets, tmp_path_factory):
+    """RL twin of the SIGTERM parity test, in strict (pipelined=False) mode:
+    preempt mid-RL-epoch, resume, final params match the uninterrupted run
+    bit-for-bit (batch order, sampling rng chain, and optimizer moments all
+    continue mid-epoch)."""
+    train_ds, _ = datasets
+    d1 = str(tmp_path_factory.mktemp("rlstraight"))
+    d2 = str(tmp_path_factory.mktemp("rlpreempt"))
+
+    def run(ckpt_dir, resume=""):
+        cfg = make_cfg(ckpt_dir, len(train_ds.vocab), epochs=1, resume=resume)
+        tr = Trainer(cfg, train_ds, None, log_path=ckpt_dir + "/ev.jsonl",
+                     use_mesh=False)
+        tr.train_xe()
+        tr.train_rl()
+        return tr
+
+    tr_straight = run(d1)
+
+    # 12 videos / batch 8 = 2 RL batches/epoch; preempt in epoch 2 batch 1
+    # (0-based visit 2 of rl.step)
+    with FaultPlan([Fault("rl.step", "preempt", at=2)]).activate():
+        with pytest.raises(Preempted):
+            run(d2)
+    saves = events_of(d2 + "/ev.jsonl", "ckpt_step")
+    assert saves and saves[-1]["phase"] == "rl"
+    assert saves[-1]["batch_index"] == 1
+
+    tr_res = run(d2, resume="auto")
+    assert tr_res.rl_epochs == tr_straight.rl_epochs == 2
+    assert int(tr_res.state.step) == int(tr_straight.state.step)
+    params_equal(tr_straight.state.params, tr_res.state.params)
+
+
+def test_transient_reward_failures_are_retried(datasets, tmp_path_factory):
+    train_ds, _ = datasets
+    d = str(tmp_path_factory.mktemp("rewardretry"))
+    cfg = make_cfg(d, len(train_ds.vocab), epochs=1)
+    cfg = dataclasses.replace(cfg, rl=dataclasses.replace(cfg.rl, epochs=1))
+    tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl", use_mesh=False)
+    tr.train_xe()
+    with FaultPlan([Fault("reward.call", "io_error", at=0, times=1)]).activate():
+        tr.train_rl()
+    assert tr.rl_epochs == 1
+    retries = events_of(d + "/ev.jsonl", "reward_retry")
+    assert len(retries) == 1 and retries[0]["error"] == "TransientIOError"

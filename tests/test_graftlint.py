@@ -395,6 +395,91 @@ def test_gl008_negative_slow_marked(tmp_path):
     assert findings == []
 
 
+# ---- GL009: silently swallowed broad exceptions -----------------------------
+
+def test_gl009_positive_swallowed_continue(tmp_path):
+    findings = _lint(
+        tmp_path, "cst_captioning_tpu/ckpt/fake.py", (
+            "def restore(candidates):\n"
+            "    for c in candidates:\n"
+            "        try:\n"
+            "            return load(c)\n"
+            "        except Exception:\n"
+            "            continue\n"
+        ), rules=["GL009"],
+    )
+    assert _rules_of(findings) == ["GL009"]
+    assert findings[0].severity == "warning"
+
+
+def test_gl009_positive_bare_except_pass(tmp_path):
+    findings = _lint(
+        tmp_path, "cst_captioning_tpu/utils/fake.py", (
+            "def close(fh):\n"
+            "    try:\n"
+            "        fh.close()\n"
+            "    except:\n"
+            "        pass\n"
+        ), rules=["GL009"],
+    )
+    assert _rules_of(findings) == ["GL009"]
+
+
+def test_gl009_positive_tuple_containing_exception(tmp_path):
+    findings = _lint(
+        tmp_path, "cst_captioning_tpu/data/fake.py", (
+            "def read(path):\n"
+            "    try:\n"
+            "        return open(path).read()\n"
+            "    except (OSError, Exception):\n"
+            "        pass\n"
+        ), rules=["GL009"],
+    )
+    assert _rules_of(findings) == ["GL009"]
+
+
+def test_gl009_negative_logged_fallback_and_narrow_types(tmp_path):
+    # logging before falling back is exactly the prescribed fix
+    findings = _lint(
+        tmp_path, "cst_captioning_tpu/ckpt/fake.py", (
+            "def restore(candidates, log):\n"
+            "    for c in candidates:\n"
+            "        try:\n"
+            "            return load(c)\n"
+            "        except Exception as e:\n"
+            "            log('ckpt_corrupt', name=c, error=str(e))\n"
+            "            continue\n"
+        ), rules=["GL009"],
+    )
+    assert findings == []
+    # a narrow exception type is a deliberate contract, even when silent
+    findings = _lint(
+        tmp_path, "cst_captioning_tpu/data/fake.py", (
+            "import queue\n"
+            "def drain(q):\n"
+            "    try:\n"
+            "        q.get_nowait()\n"
+            "    except queue.Empty:\n"
+            "        pass\n"
+        ), rules=["GL009"],
+    )
+    assert findings == []
+
+
+def test_gl009_not_applied_outside_package(tmp_path):
+    # tests/benches swallow on purpose when asserting failure modes
+    findings = _lint(
+        tmp_path, "tests/test_fake.py", (
+            "def test_x():\n"
+            "    try:\n"
+            "        boom()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        ), rules=["GL009"],
+    )
+    assert findings == []
+
+
 # ---- suppressions -----------------------------------------------------------
 
 def test_inline_suppression_same_line(tmp_path):
@@ -523,11 +608,11 @@ def test_cli_write_baseline_then_clean(tmp_path, capsys):
     assert cli_main([str(path), "--root", str(tmp_path)]) == 0
 
 
-def test_cli_list_rules_names_all_eight(tmp_path, capsys):
+def test_cli_list_rules_names_all_nine(tmp_path, capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008"):
+                "GL007", "GL008", "GL009"):
         assert rid in out
 
 

@@ -1,0 +1,439 @@
+"""Unit tests for the resilience layer: durable checkpoints + manifest
+verification, retry/backoff, divergence sentinel, preemption handler, chaos
+plan determinism, and the crash-safe EventLogger."""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.ckpt import CheckpointManager, load_state, save_state
+from cst_captioning_tpu.config.config import ModelConfig, TrainConfig
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.resilience import chaos
+from cst_captioning_tpu.resilience.chaos import Fault, FaultPlan, SimulatedKill
+from cst_captioning_tpu.resilience.durable import (
+    CorruptCheckpointError,
+    MANIFEST_FILE,
+    verify_manifest,
+    write_manifest,
+)
+from cst_captioning_tpu.resilience.preempt import PreemptionHandler
+from cst_captioning_tpu.resilience.retry import RetryPolicy, retry_call
+from cst_captioning_tpu.resilience.sentinel import (
+    DivergenceSentinel,
+    RollbackRequested,
+    TrainingDiverged,
+)
+from cst_captioning_tpu.train import create_train_state, make_optimizer
+from cst_captioning_tpu.utils.logging import EventLogger
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    cfg = ModelConfig(
+        vocab_size=12, modalities=(("resnet", 6),), d_embed=8, d_hidden=8,
+        d_att=4, encoder="meanpool", max_len=5, max_frames=3, dtype="float32",
+    )
+    model = CaptionModel(cfg)
+    rng = np.random.default_rng(0)
+    feats = {"resnet": jnp.asarray(rng.normal(size=(2, 3, 6)), jnp.float32)}
+    masks = {"resnet": jnp.ones((2, 3), jnp.float32)}
+    labels = jnp.asarray(rng.integers(4, 12, size=(2, 5)), jnp.int32)
+    tx = make_optimizer(TrainConfig(lr=1e-3), 10)
+    return create_train_state(model, tx, (feats, masks, labels), seed=0)
+
+
+class LogSink:
+    """EventLogger.log-compatible callable that records events."""
+
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, event, **fields):
+        self.events.append({"event": event, **fields})
+
+    def of(self, kind):
+        return [e for e in self.events if e["event"] == kind]
+
+
+# ---- durable.py -------------------------------------------------------------
+
+def test_manifest_roundtrip_and_truncation(tmp_path):
+    d = str(tmp_path)
+    blob = b"x" * 1000
+    with open(os.path.join(d, "state.msgpack"), "wb") as f:
+        f.write(blob)
+    write_manifest(d, {"state.msgpack": blob})
+    assert verify_manifest(d) is True
+
+    with open(os.path.join(d, "state.msgpack"), "r+b") as f:
+        f.truncate(500)
+    with pytest.raises(CorruptCheckpointError, match="size"):
+        verify_manifest(d)
+
+    # same size, flipped bytes -> checksum catches it
+    with open(os.path.join(d, "state.msgpack"), "wb") as f:
+        f.write(b"y" * 1000)
+    with pytest.raises(CorruptCheckpointError, match="sha256"):
+        verify_manifest(d)
+
+
+def test_manifest_missing_is_legacy_not_error(tmp_path):
+    assert verify_manifest(str(tmp_path)) is False
+
+
+def test_save_state_writes_verified_manifest(tiny_state, tmp_path):
+    path = save_state(str(tmp_path), "latest", tiny_state, {"epoch": 1})
+    assert verify_manifest(path) is True
+    manifest = json.load(open(os.path.join(path, MANIFEST_FILE)))
+    assert set(manifest["files"]) == {"state.msgpack", "infos.json"}
+
+
+def test_truncated_state_detected_on_load(tiny_state, tmp_path):
+    save_state(str(tmp_path), "latest", tiny_state)
+    sp = os.path.join(str(tmp_path), "latest", "state.msgpack")
+    with open(sp, "r+b") as f:
+        f.truncate(os.path.getsize(sp) // 2)
+    with pytest.raises(CorruptCheckpointError):
+        load_state(str(tmp_path), "latest", tiny_state)
+
+
+def test_resave_keeps_previous_generation(tiny_state, tmp_path):
+    save_state(str(tmp_path), "latest", tiny_state, {"epoch": 1})
+    save_state(str(tmp_path), "latest", tiny_state, {"epoch": 2})
+    _, infos = load_state(str(tmp_path), "latest", tiny_state)
+    assert infos["epoch"] == 2
+    # the demoted generation is intact and loadable
+    _, prev_infos = load_state(str(tmp_path), "latest.prev", tiny_state)
+    assert prev_infos["epoch"] == 1
+
+
+# ---- chaos.py ---------------------------------------------------------------
+
+def test_chaos_inactive_is_noop():
+    payload = object()
+    assert chaos.visit("anything", payload) is payload
+
+
+def test_chaos_kill_fires_at_exact_visit():
+    plan = FaultPlan([Fault("pt", "kill", at=2)])
+    with plan.activate():
+        chaos.visit("pt")
+        chaos.visit("pt")
+        with pytest.raises(SimulatedKill):
+            chaos.visit("pt")
+    assert plan.fired == [{"point": "pt", "kind": "kill", "visit": 2}]
+    # deactivated again
+    chaos.visit("pt")
+
+
+def test_chaos_io_error_window_then_clean():
+    plan = FaultPlan([Fault("io", "io_error", at=0, times=2)])
+    with plan.activate():
+        for _ in range(2):
+            with pytest.raises(OSError):
+                chaos.visit("io")
+        chaos.visit("io")  # third visit is clean
+    assert plan.visits("io") == 3
+
+
+def test_chaos_seeded_random_at_is_deterministic():
+    spec = [Fault("pt", "kill", at=("rand", 5, 50))]
+    a = FaultPlan(list(spec), seed=7)
+    b = FaultPlan([Fault("pt", "kill", at=("rand", 5, 50))], seed=7)
+    c = FaultPlan([Fault("pt", "kill", at=("rand", 5, 50))], seed=8)
+    assert a.faults[0].at == b.faults[0].at
+    assert 5 <= a.faults[0].at < 50
+    assert a.faults[0].at != c.faults[0].at or True  # seeds may collide; just bounds-check c
+    assert 5 <= c.faults[0].at < 50
+
+
+def test_chaos_nan_poisons_batch_features():
+    class B:
+        feats = {"resnet": np.ones((2, 3), np.float32)}
+
+    plan = FaultPlan([Fault("b", "nan", at=1)])
+    with plan.activate():
+        clean = B()
+        chaos.visit("b", clean)
+        assert np.isfinite(clean.feats["resnet"]).all()
+        poisoned = B()
+        chaos.visit("b", poisoned)
+        assert np.isnan(poisoned.feats["resnet"]).all()
+
+
+def test_chaos_single_active_plan():
+    p1, p2 = FaultPlan([]), FaultPlan([])
+    with p1.activate():
+        with pytest.raises(RuntimeError, match="already active"):
+            p2.activate().__enter__()
+
+
+# ---- retry.py ---------------------------------------------------------------
+
+def test_retry_succeeds_after_transients():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    events = []
+    out = retry_call(
+        flaky,
+        policy=RetryPolicy(max_attempts=4, base_delay=0.01, seed=1),
+        on_retry=events.append,
+        sleep=sleeps.append,
+    )
+    assert out == "ok" and calls["n"] == 3
+    assert len(events) == 2 and len(sleeps) == 2
+    assert events[0]["error"] == "OSError" and events[0]["attempt"] == 1
+
+
+def test_retry_exhausts_attempts_and_reraises():
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError, match="down"):
+        retry_call(
+            always,
+            policy=RetryPolicy(max_attempts=3, base_delay=0.001),
+            sleep=lambda d: None,
+        )
+
+
+def test_retry_budget_caps_total_sleep():
+    def always():
+        raise OSError("down")
+
+    sleeps = []
+    with pytest.raises(OSError):
+        retry_call(
+            always,
+            policy=RetryPolicy(
+                max_attempts=10, base_delay=1.0, factor=1.0, jitter=0.0,
+                budget=2.5,
+            ),
+            sleep=sleeps.append,
+        )
+    assert len(sleeps) == 2  # third 1s sleep would exceed the 2.5s budget
+
+
+def test_retry_nonretryable_propagates_immediately():
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        retry_call(boom, policy=RetryPolicy(max_attempts=5),
+                   sleep=lambda d: None)
+    assert calls["n"] == 1
+
+
+def test_retry_jitter_is_seed_deterministic():
+    p = RetryPolicy(max_attempts=5, seed=42)
+    assert p.delays() == RetryPolicy(max_attempts=5, seed=42).delays()
+    assert p.delays() != RetryPolicy(max_attempts=5, seed=43).delays()
+
+
+def test_simulated_kill_escapes_retry():
+    def killed():
+        raise SimulatedKill("host died")
+
+    with pytest.raises(SimulatedKill):
+        retry_call(killed, policy=RetryPolicy(max_attempts=5),
+                   sleep=lambda d: None)
+
+
+# ---- sentinel.py ------------------------------------------------------------
+
+def test_sentinel_skip_batch_logs_and_continues():
+    log = LogSink()
+    s = DivergenceSentinel(policy="skip_batch", log=log)
+    s.push(1, jnp.float32(1.0), jnp.float32(0.0))
+    s.push(2, jnp.float32(float("nan")), jnp.float32(1.0))
+    s.push(3, jnp.float32(0.9), jnp.float32(0.0))
+    s.flush()
+    events = log.of("divergence")
+    assert len(events) == 1
+    assert events[0]["step"] == 2 and events[0]["kind"] == "nonfinite"
+    assert events[0]["action"] == "skip_batch"
+    assert s.skipped == 1
+
+
+def test_sentinel_abort_raises():
+    s = DivergenceSentinel(policy="abort")
+    s.push(1, jnp.float32(float("inf")), None)
+    with pytest.raises(TrainingDiverged):
+        s.flush()
+
+
+def test_sentinel_rollback_raises_with_context():
+    s = DivergenceSentinel(policy="rollback", check_every=1)
+    with pytest.raises(RollbackRequested) as ei:
+        s.push(7, jnp.float32(float("nan")), jnp.float32(1.0))
+    assert ei.value.step == 7 and ei.value.kind == "nonfinite"
+
+
+def test_sentinel_spike_detection_after_warmup():
+    log = LogSink()
+    s = DivergenceSentinel(
+        policy="abort", log=log, spike_factor=5.0, warmup=4,
+    )
+    for i in range(6):
+        s.push(i, jnp.float32(1.0), None)
+    s.flush()
+    s.push(10, jnp.float32(50.0), None)  # 50x the median
+    with pytest.raises(TrainingDiverged):
+        s.flush()
+    assert log.of("divergence")[0]["kind"] == "spike"
+    # under skip_batch a spike is logged, not acted on (update already applied)
+    log2 = LogSink()
+    s2 = DivergenceSentinel(
+        policy="skip_batch", log=log2, spike_factor=5.0, warmup=4,
+    )
+    for i in range(6):
+        s2.push(i, jnp.float32(1.0), None)
+    s2.push(10, jnp.float32(50.0), None)
+    s2.flush()
+    assert log2.of("divergence")[0]["action"] == "logged"
+
+
+def test_sentinel_off_is_free():
+    s = DivergenceSentinel(policy="off")
+    s.push(1, jnp.float32(float("nan")), jnp.float32(1.0))
+    s.flush()  # no readback, no raise
+    assert s._buf == []
+
+
+# ---- preempt.py -------------------------------------------------------------
+
+def test_preemption_handler_latches_sigterm():
+    with PreemptionHandler() as h:
+        assert h.installed and not h.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.requested
+    # prior disposition restored
+    assert signal.getsignal(signal.SIGTERM) != h._on_signal
+
+
+def test_preemption_handler_chains_previous_python_handler():
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        with PreemptionHandler() as h:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert h.requested and hits == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---- CheckpointManager: rotation, ordering, corrupt fallback ----------------
+
+def test_step_checkpoint_rotation(tiny_state, tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    for step in (10, 20, 30, 40):
+        mgr.save_step(tiny_state, step, {"epoch": 0})
+    assert [s for s, _ in mgr.step_checkpoints()] == [30, 40]
+
+
+def test_restore_prefers_newest_by_global_step(tiny_state, tmp_path):
+    log = LogSink()
+    mgr = CheckpointManager(str(tmp_path), keep=3, log=log)
+    mgr.save(tiny_state, value=None, infos={"epoch": 1, "global_step": 6})
+    mgr.save_step(tiny_state, 9, {"epoch": 1, "batch_index": 3})
+    restored = mgr.restore_latest(tiny_state)
+    assert restored is not None
+    _, infos = restored
+    assert infos["global_step"] == 9 and infos["batch_index"] == 3
+
+
+def test_corrupt_latest_falls_back_with_logged_event(tiny_state, tmp_path):
+    log = LogSink()
+    mgr = CheckpointManager(str(tmp_path), log=log)
+    mgr.save(tiny_state, value=0.5, infos={"epoch": 1, "global_step": 6})
+    sp = os.path.join(str(tmp_path), "latest", "state.msgpack")
+    with open(sp, "r+b") as f:
+        f.truncate(os.path.getsize(sp) // 2)
+    restored = mgr.restore_latest(tiny_state)
+    assert restored is not None  # fell back to 'best'
+    _, infos = restored
+    assert infos["epoch"] == 1
+    events = log.of("ckpt_corrupt")
+    assert len(events) == 1 and events[0]["name"] == "latest"
+    assert events[0]["error"] == "CorruptCheckpointError"
+
+
+def test_kill_mid_save_previous_generation_survives(tiny_state, tmp_path):
+    log = LogSink()
+    mgr = CheckpointManager(str(tmp_path), log=log)
+    mgr.save(tiny_state, value=None, infos={"epoch": 1, "global_step": 5})
+    # the second save dies after writing state.msgpack, before the swap
+    plan = FaultPlan([Fault("ckpt.state_written", "kill", at=0)])
+    with plan.activate():
+        with pytest.raises(SimulatedKill):
+            mgr.save(tiny_state, value=None,
+                     infos={"epoch": 2, "global_step": 10})
+    # previous generation intact, verified, and picked up on restore
+    restored = mgr.restore_latest(tiny_state)
+    assert restored is not None
+    assert restored[1]["epoch"] == 1
+    assert log.of("ckpt_corrupt") == []
+    # the next save reclaims the stale .tmp and completes
+    mgr.save(tiny_state, value=None, infos={"epoch": 3, "global_step": 15})
+    assert mgr.restore_latest(tiny_state)[1]["epoch"] == 3
+
+
+def test_save_retries_transient_io_errors(tiny_state, tmp_path):
+    log = LogSink()
+    mgr = CheckpointManager(
+        str(tmp_path), log=log,
+        retry=RetryPolicy(max_attempts=4, base_delay=0.001),
+    )
+    plan = FaultPlan([Fault("ckpt.save", "io_error", at=0, times=2)])
+    with plan.activate():
+        mgr.save(tiny_state, value=None, infos={"epoch": 1})
+    assert len(log.of("ckpt_retry")) == 2
+    assert mgr.restore_latest(tiny_state) is not None
+
+
+# ---- EventLogger ------------------------------------------------------------
+
+def test_event_logger_context_manager_records_crash(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with pytest.raises(RuntimeError):
+        with EventLogger(path, echo=False) as log:
+            log.log("step", loss=1.0)
+            raise RuntimeError("boom mid-epoch")
+    events = [json.loads(l) for l in open(path)]
+    assert [e["event"] for e in events] == ["step", "crash"]
+    assert events[-1]["error"] == "RuntimeError"
+    assert "boom" in events[-1]["detail"]
+
+
+def test_event_logger_clean_exit_no_crash_event(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLogger(path, echo=False) as log:
+        log.log("step", loss=1.0)
+    events = [json.loads(l) for l in open(path)]
+    assert [e["event"] for e in events] == ["step"]
+
+
+def test_event_logger_flush_and_double_close(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLogger(path, echo=False)
+    log.log("a")
+    log.flush()
+    assert [json.loads(l)["event"] for l in open(path)] == ["a"]
+    log.close()
+    log.close()  # idempotent (atexit may race a manual close)
