@@ -26,6 +26,7 @@ from typing import Any, Callable
 import jax
 from flax import serialization
 
+from cst_captioning_tpu import obs
 from cst_captioning_tpu.resilience import chaos
 from cst_captioning_tpu.resilience.durable import (
     CorruptCheckpointError,
@@ -169,11 +170,14 @@ class CheckpointManager:
 
     def _save(self, name: str, state: TrainState, infos: dict) -> str:
         """One durable save with jittered-backoff retries on transient I/O."""
-        return retry_call(
-            save_state, self.ckpt_dir, name, state, infos,
-            policy=self.retry,
-            on_retry=lambda info: self.log("ckpt_retry", name=name, **info),
-        )
+        # the span covers retries + backoff sleeps: its dur IS the stall a
+        # save inflicts on the step loop (the "ckpt" phase of the report)
+        with obs.span("ckpt.save", ckpt=name):
+            return retry_call(
+                save_state, self.ckpt_dir, name, state, infos,
+                policy=self.retry,
+                on_retry=lambda info: self.log("ckpt_retry", name=name, **info),
+            )
 
     def save(self, state: TrainState, value: float | None = None,
              infos: dict | None = None) -> bool:
@@ -245,16 +249,19 @@ class CheckpointManager:
 
         A corrupt/partial candidate is never silently skipped: each failure
         is logged as a structured ``ckpt_corrupt`` event (candidate name,
-        error class, detail) before falling back to the next generation."""
-        for name in self._candidates():
-            try:
-                return load_state(self.ckpt_dir, name, template)
-            except Exception as e:
-                self.log(
-                    "ckpt_corrupt",
-                    name=name,
-                    error=type(e).__name__,
-                    detail=str(e),
-                )
-                continue  # verified-corrupt (and logged): try the next one
-        return None
+        error class, detail) AND counts on ``resilience.ckpt_corrupt``
+        before falling back to the next generation."""
+        with obs.span("ckpt.restore"):
+            for name in self._candidates():
+                try:
+                    return load_state(self.ckpt_dir, name, template)
+                except Exception as e:
+                    obs.counter("resilience.ckpt_corrupt").inc()
+                    self.log(
+                        "ckpt_corrupt",
+                        name=name,
+                        error=type(e).__name__,
+                        detail=str(e),
+                    )
+                    continue  # verified-corrupt (and logged): try the next
+            return None

@@ -34,6 +34,15 @@ def add_common_args(p: argparse.ArgumentParser) -> None:
         help="write a jax.profiler trace of training steps to DIR",
     )
     p.add_argument(
+        "--obs",
+        default="",
+        metavar="DIR",
+        help="enable the observability subsystem and write the run's "
+             "events.jsonl / trace.json / metrics.prom to DIR "
+             "(sets train.obs + train.obs_dir; report with "
+             "`python -m cst_captioning_tpu.cli.obs_report DIR`)",
+    )
+    p.add_argument(
         "--debug-nans",
         action="store_true",
         help="enable the jax_debug_nans sanitizer (raises at the first NaN)",
@@ -58,6 +67,9 @@ def load_config(args: argparse.Namespace) -> ExperimentConfig:
     overrides = parse_overrides(args.set)
     if getattr(args, "profile", ""):
         overrides["train__profile_dir"] = args.profile
+    if getattr(args, "obs", ""):
+        overrides["train__obs"] = True
+        overrides["train__obs_dir"] = args.obs
     if getattr(args, "debug_nans", False):
         overrides["train__debug_nans"] = True
     if overrides:

@@ -29,11 +29,20 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--results-json", default="results.json")
     args = p.parse_args(argv)
 
+    from cst_captioning_tpu import obs
     from cst_captioning_tpu.train import multihost
 
     multihost.initialize()  # no-op unless the JAX_* cluster env vars are set
     cfg = load_config(args)
     split = args.split or cfg.eval.split
+    if cfg.train.obs:
+        # standalone eval runs get their own obs stream (the Evaluator's
+        # "eval" spans + prefetchless decode metrics land here); report it
+        # with cli.obs_report like a training run
+        obs_dir = cfg.train.obs_dir or "obs_eval"
+        if jax.process_index() != 0:
+            obs_dir = f"{obs_dir}/proc{jax.process_index()}"
+        obs.configure(obs_dir, run=f"{cfg.name}-eval-{split}")
     ds = open_dataset(args, cfg, split)
 
     model = CaptionModel(cfg.model)
@@ -59,11 +68,14 @@ def main(argv: list[str] | None = None) -> None:
     # multi-host: every process computes the full result (the caption gather
     # is collective), but only process 0 writes the shared results file
     results_json = args.results_json if jax.process_index() == 0 else ""
-    result = evaluate_split(
-        model, params, ds, cfg.eval,
-        batch_size=cfg.data.batch_size, results_json=results_json,
-        mesh=mesh,
-    )
+    try:
+        result = evaluate_split(
+            model, params, ds, cfg.eval,
+            batch_size=cfg.data.batch_size, results_json=results_json,
+            mesh=mesh,
+        )
+    finally:
+        obs.shutdown()
     if jax.process_index() == 0:
         print(json.dumps(result["metrics"], indent=2, default=float))
 

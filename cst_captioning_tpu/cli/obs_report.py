@@ -1,0 +1,45 @@
+"""Run report CLI: phase breakdown + resilience summary from an obs run dir.
+
+    python -m cst_captioning_tpu.cli.obs_report <run_dir> [--json]
+
+``<run_dir>`` is the directory ``train.obs_dir`` (or ``--obs``) pointed a
+run at — it must contain the run's ``events.jsonl``. Prints the phase table
+(per-phase totals, self-time %-of-wall-clock, p50/p95/max) and the
+resilience summary (nan-skips, rollbacks, retries, chaos faults). Pure
+stdlib — no jax import, safe anywhere (scripts/lint.sh runs it as a smoke
+check against the committed fixture run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from cst_captioning_tpu.obs.report import render_report, report_run
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="obs_report",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("run_dir", help="obs run directory (holds events.jsonl)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the machine-readable report on stdout")
+    args = p.parse_args(argv)
+    try:
+        report = report_run(args.run_dir)
+    except FileNotFoundError as e:
+        print(f"obs_report: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(report, indent=2, default=float))
+    else:
+        print(render_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

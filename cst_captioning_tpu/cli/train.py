@@ -45,13 +45,21 @@ def main(argv: list[str] | None = None) -> None:
             raise
         val_ds = None
 
-    trainer = Trainer(cfg, train_ds, val_ds, log_path=args.log_jsonl)
-    if not args.skip_xe:
-        trainer.train_xe()
-    if cfg.rl.enabled:
-        if cfg.rl.init_from:
-            trainer.load_params_from(cfg.rl.init_from, "best")
-        trainer.train_rl()
+    # the Trainer configures the obs recorder from cfg.train.obs; the CLI
+    # owns finalization so a crashed/finished run still gets its trace.json
+    # + final metrics snapshot (obs.shutdown is a no-op when obs is off)
+    from cst_captioning_tpu import obs
+
+    try:
+        trainer = Trainer(cfg, train_ds, val_ds, log_path=args.log_jsonl)
+        if not args.skip_xe:
+            trainer.train_xe()
+        if cfg.rl.enabled:
+            if cfg.rl.init_from:
+                trainer.load_params_from(cfg.rl.init_from, "best")
+            trainer.train_rl()
+    finally:
+        obs.shutdown()
 
 
 if __name__ == "__main__":

@@ -152,10 +152,15 @@ class TrainConfig:
     eval_every_epochs: int = 1
     ckpt_dir: str = "checkpoints"
     resume: str = ""                    # "", "auto", or explicit ckpt path
-    # observability (SURVEY.md §5 rows 1-2)
+    # observability (SURVEY.md §5 rows 1-2; obs/ package)
     profile_dir: str = ""               # jax.profiler trace output dir ("" = off)
     profile_steps: int = 10             # steps to trace (after the compile step)
     debug_nans: bool = False            # jax_debug_nans sanitizer mode
+    # unified obs subsystem (spans + metrics + run report, README
+    # "Observability"): off by default — every span/counter call in the hot
+    # paths degrades to a no-op. Snapshot cadence rides log_every_steps.
+    obs: bool = False
+    obs_dir: str = ""                   # run dir ("" = <ckpt_dir>/obs)
     # ---- resilience (resilience/ package; README "Preemption-safe training")
     # mid-epoch step_<n> checkpoint interval, in steps (0 = epoch-end saves
     # only; SIGTERM-triggered saves happen regardless)

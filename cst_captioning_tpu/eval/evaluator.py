@@ -18,6 +18,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from cst_captioning_tpu import obs
 from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.config.config import EvalConfig
 from cst_captioning_tpu.data.batcher import Batcher
@@ -193,14 +194,18 @@ class Evaluator:
         Multi-host: only process 0 runs the metric scorers (pure host
         compute on inputs every process already holds); the metrics dict is
         broadcast so the return value is identical everywhere."""
-        captions = self.generate(params)
-        metrics = None
-        if not self.multiproc or jax.process_index() == 0:
-            gts = {vid: list(caps) for vid, caps in self.ds.gts_pool().items()}
-            res = {vid: [captions[vid]] for vid in captions}
-            metrics = self._scorer.score(gts, res)
-        if self.multiproc:
-            metrics = multihost.broadcast_pyobj(metrics)
+        with obs.span("eval", split=self.ds.split):
+            captions = self.generate(params)
+            metrics = None
+            if not self.multiproc or jax.process_index() == 0:
+                gts = {
+                    vid: list(caps) for vid, caps in self.ds.gts_pool().items()
+                }
+                res = {vid: [captions[vid]] for vid in captions}
+                with obs.span("eval.score"):
+                    metrics = self._scorer.score(gts, res)
+            if self.multiproc:
+                metrics = multihost.broadcast_pyobj(metrics)
         result = {"split": self.ds.split, "metrics": metrics, "captions": captions}
         if results_json and self.multiproc and jax.process_index() != 0:
             # shared-filesystem contract (same as checkpointing): N identical

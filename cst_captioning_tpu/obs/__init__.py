@@ -1,0 +1,60 @@
+"""Unified observability: tracing spans, metrics registry, run reports.
+
+The subsystem threaded through trainer/SCST/evaluator/prefetch/ckpt/
+resilience (README "Observability"):
+
+- :mod:`obs.span`    — nested wall-clock spans + the run recorder
+  (``events.jsonl``, Perfetto ``trace.json``); ``obs.span("rl.decode")`` is
+  a no-op identity check when no recorder is configured.
+- :mod:`obs.metrics` — process-wide counters/gauges/histograms, snapshotted
+  into the event stream on the ``train.log_every_steps`` cadence and
+  exported as a Prometheus textfile.
+- :mod:`obs.report`  — aggregates a run dir into the phase-breakdown +
+  resilience report behind ``python -m cst_captioning_tpu.cli.obs_report``.
+
+Stdlib-only at import time (jax is touched lazily, for the optional
+device-memory gauges and the jax.monitoring compile listener), and
+zero-sync by construction: nothing in here reads a device value.
+"""
+
+from cst_captioning_tpu.obs.metrics import (
+    REGISTRY,
+    StepMeter,
+    counter,
+    gauge,
+    histogram,
+    snapshot,
+)
+from cst_captioning_tpu.obs.span import (
+    ObsRecorder,
+    Span,
+    active,
+    configure,
+    enabled,
+    event,
+    maybe_snapshot,
+    set_context,
+    shutdown,
+    snapshot_metrics,
+    span,
+)
+
+__all__ = [
+    "REGISTRY",
+    "ObsRecorder",
+    "Span",
+    "StepMeter",
+    "active",
+    "configure",
+    "counter",
+    "enabled",
+    "event",
+    "gauge",
+    "histogram",
+    "maybe_snapshot",
+    "set_context",
+    "shutdown",
+    "snapshot",
+    "snapshot_metrics",
+    "span",
+]

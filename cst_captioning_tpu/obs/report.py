@@ -1,0 +1,256 @@
+"""Turn a run's obs event stream into a phase-breakdown + resilience report.
+
+Pure stdlib over ``<run_dir>/events.jsonl`` (the :mod:`obs.span` stream) —
+no jax import, so ``python -m cst_captioning_tpu.cli.obs_report`` runs
+anywhere in milliseconds (scripts/lint.sh uses it as a smoke check).
+
+Accounting model: every finished span carries its full duration AND its
+*self* time (duration minus time spent in child spans on the same thread).
+Grouping self-time by span name partitions the instrumented wall clock
+exactly — nested spans never double count — so the phase table's totals sum
+to the span-covered fraction of the run, and ``coverage`` says how much of
+the measured wall clock the instrumentation explains. p50/p95/max are over
+full per-span durations (the latency view); totals/percentages are over
+self time (the where-did-the-time-go view). Only spans from the run's
+foreground thread (the one that configured the recorder) enter the phase
+table: background threads (the prefetch worker) and virtual-track windows
+(the profiler trace) run CONCURRENTLY with it — they're reported in a
+separate overlap section, never summed against wall clock.
+
+The resilience summary reads the LAST metrics snapshot in the stream —
+counters are cumulative, so the newest snapshot is the run total even if
+the run died between cadenced snapshots.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterable
+
+EVENTS_FILE = "events.jsonl"
+
+# canonical phase order for the table; unknown names sort after, by total
+_PHASE_ORDER = (
+    "setup", "xe.epoch", "xe.step", "rl.epoch", "rl.decode", "rl.reward",
+    "rl.update", "eval", "eval.score", "ckpt", "ckpt.save", "ckpt.restore",
+    "prefetch.stage", "profile.window",
+)
+
+
+def load_events(run_dir: str) -> list[dict]:
+    path = os.path.join(run_dir, EVENTS_FILE)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no {EVENTS_FILE} under {run_dir!r} — was the run started with "
+            "train.obs enabled (or --obs)?"
+        )
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # torn final line of a killed run
+    return out
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Exact nearest-rank-interpolated percentile over raw durations."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def build_report(events: Iterable[dict]) -> dict[str, Any]:
+    """Aggregate an event stream into the report structure (JSON-ready)."""
+    events = list(events)
+    spans: dict[str, dict] = {}
+    overlap: dict[str, dict] = {}
+    t_first = t_last = None
+    t_start = t_end = None
+    run = ""
+    main_thread: str | None = None
+    last_metrics: dict | None = None
+    profiler_windows = 0
+
+    for ev in events:
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            t_first = ts if t_first is None else min(t_first, ts)
+            t_last = ts if t_last is None else max(t_last, ts)
+        kind = ev.get("event")
+        if kind == "run_start":
+            t_start = ts
+            run = ev.get("run", run)
+            main_thread = ev.get("thread", main_thread)
+        elif kind == "run_end":
+            t_end = ts
+        elif kind == "metrics":
+            last_metrics = ev
+        elif kind == "profiler_trace_written":
+            profiler_windows += 1
+        elif kind == "span":
+            name = str(ev.get("name", "?"))
+            foreground = not ev.get("track") and (
+                main_thread is None or ev.get("thread", main_thread) == main_thread
+            )
+            agg = (spans if foreground else overlap).setdefault(
+                name, {"count": 0, "total": 0.0, "self_total": 0.0,
+                       "durs": []},
+            )
+            dur = float(ev.get("dur", 0.0))
+            agg["count"] += 1
+            agg["total"] += dur
+            agg["self_total"] += float(ev.get("self_dur", dur))
+            agg["durs"].append(dur)
+
+    wall = 0.0
+    if t_start is not None and t_end is not None:
+        wall = max(t_end - t_start, 0.0)
+    elif t_first is not None and t_last is not None:
+        wall = max(t_last - t_first, 0.0)
+
+    order = {name: i for i, name in enumerate(_PHASE_ORDER)}
+
+    def rows(groups: dict[str, dict]) -> list[dict]:
+        out = []
+        for name, agg in groups.items():
+            durs = sorted(agg["durs"])
+            out.append({
+                "phase": name,
+                "count": agg["count"],
+                "total_s": agg["total"],
+                "self_s": agg["self_total"],
+                "pct_wall": (
+                    100.0 * agg["self_total"] / wall if wall > 0 else 0.0
+                ),
+                "p50_s": _percentile(durs, 0.50),
+                "p95_s": _percentile(durs, 0.95),
+                "max_s": durs[-1] if durs else 0.0,
+            })
+        out.sort(key=lambda p: (order.get(p["phase"], len(order)),
+                                -p["self_s"]))
+        return out
+
+    phases = rows(spans)
+    overlap_rows = rows(overlap)
+    covered = sum(p["self_s"] for p in phases)
+
+    counters = (last_metrics or {}).get("counters", {})
+    resilience = {
+        "nan_skips": counters.get("resilience.nan_skip", 0),
+        "divergences": sum(
+            v for k, v in counters.items()
+            if k.startswith("resilience.divergence.")
+        ),
+        "rollbacks": counters.get("resilience.rollback", 0),
+        "retry_attempts": counters.get("resilience.retry.attempt", 0),
+        "retry_give_ups": counters.get("resilience.retry.give_up", 0),
+        "ckpt_corrupt_fallbacks": counters.get("resilience.ckpt_corrupt", 0),
+        "chaos_faults": counters.get("resilience.chaos_fault", 0),
+        "chaos_faults_by_kind": {
+            k.rsplit(".", 1)[1]: v
+            for k, v in counters.items()
+            if k.startswith("resilience.chaos_fault.")
+        },
+    }
+
+    return {
+        "run": run,
+        "wall_s": wall,
+        "covered_s": covered,
+        "coverage": covered / wall if wall > 0 else 0.0,
+        "complete": t_end is not None,
+        "phases": phases,
+        "overlap": overlap_rows,
+        "resilience": resilience,
+        "compile": {
+            "count": counters.get("jit.compiles", 0),
+            "seconds": counters.get("jit.compile_seconds", 0.0),
+        },
+        "profiler_windows": profiler_windows,
+        "events": len(events),
+    }
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:8.3f}"
+
+
+def render_report(report: dict[str, Any]) -> str:
+    """Fixed-width human rendering of :func:`build_report`'s output."""
+    lines: list[str] = []
+    run = report["run"] or "(unnamed)"
+    tail = "" if report["complete"] else "  [run did not close cleanly]"
+    lines.append(f"run: {run}   wall clock: {report['wall_s']:.3f}s   "
+                 f"events: {report['events']}{tail}")
+    comp = report["compile"]
+    if comp["count"] or comp["seconds"]:
+        lines.append(
+            f"jit: {int(comp['count'])} backend compile(s), "
+            f"{comp['seconds']:.3f}s total compile time"
+        )
+    if report["profiler_windows"]:
+        lines.append(f"profiler: {report['profiler_windows']} trace "
+                     "window(s) captured")
+    lines.append("")
+    hdr = (f"{'phase':<16} {'count':>6} {'total_s':>8} {'self_s':>8} "
+           f"{'%wall':>6} {'p50_s':>8} {'p95_s':>8} {'max_s':>8}")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for p in report["phases"]:
+        lines.append(
+            f"{p['phase']:<16} {p['count']:>6} {_fmt_s(p['total_s'])} "
+            f"{_fmt_s(p['self_s'])} {p['pct_wall']:>6.1f} "
+            f"{_fmt_s(p['p50_s'])} {_fmt_s(p['p95_s'])} {_fmt_s(p['max_s'])}"
+        )
+    lines.append("-" * len(hdr))
+    lines.append(
+        f"{'covered':<16} {'':>6} {'':>8} {_fmt_s(report['covered_s'])} "
+        f"{100.0 * report['coverage']:>6.1f}"
+    )
+    if report["overlap"]:
+        lines.append("")
+        lines.append("overlapped work (background threads / virtual tracks,"
+                     " not part of the wall-clock sum):")
+        for p in report["overlap"]:
+            lines.append(
+                f"{p['phase']:<16} {p['count']:>6} {_fmt_s(p['total_s'])} "
+                f"{_fmt_s(p['self_s'])} {'':>6} "
+                f"{_fmt_s(p['p50_s'])} {_fmt_s(p['p95_s'])} "
+                f"{_fmt_s(p['max_s'])}"
+            )
+    r = report["resilience"]
+    lines.append("")
+    lines.append("resilience:")
+    lines.append(
+        f"  nan-skips: {int(r['nan_skips'])}   divergences: "
+        f"{int(r['divergences'])}   rollbacks: {int(r['rollbacks'])}"
+    )
+    lines.append(
+        f"  retries: {int(r['retry_attempts'])} attempt(s), "
+        f"{int(r['retry_give_ups'])} give-up(s)   ckpt-corrupt fallbacks: "
+        f"{int(r['ckpt_corrupt_fallbacks'])}"
+    )
+    by_kind = r["chaos_faults_by_kind"]
+    kinds = (
+        " (" + ", ".join(f"{k}={int(v)}" for k, v in sorted(by_kind.items()))
+        + ")" if by_kind else ""
+    )
+    lines.append(f"  chaos faults injected: {int(r['chaos_faults'])}{kinds}")
+    return "\n".join(lines)
+
+
+def report_run(run_dir: str) -> dict[str, Any]:
+    """Load + aggregate one run dir (the CLI's single entry point)."""
+    return build_report(load_events(run_dir))

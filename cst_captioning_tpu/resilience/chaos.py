@@ -46,6 +46,8 @@ from typing import Any
 
 import numpy as np
 
+from cst_captioning_tpu.obs import metrics as obs_metrics
+
 
 class SimulatedKill(BaseException):
     """A chaos-injected process death. BaseException on purpose: recovery
@@ -132,6 +134,10 @@ class FaultPlan:
                 self.fired.append(
                     {"point": point, "kind": f.kind, "visit": idx}
                 )
+                # chaos activations count like real faults so a chaos-run
+                # report shows exactly what was injected
+                obs_metrics.counter("resilience.chaos_fault").inc()
+                obs_metrics.counter(f"resilience.chaos_fault.{f.kind}").inc()
         # fire outside the lock: handlers/sleeps must not serialize threads
         for f in due:
             if f.kind == "kill":

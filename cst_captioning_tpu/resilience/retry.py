@@ -13,6 +13,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
+from cst_captioning_tpu.obs import metrics as obs_metrics
+
 
 @dataclass(frozen=True)
 class RetryPolicy:
@@ -62,11 +64,17 @@ def retry_call(
         try:
             return fn(*args, **kwargs)
         except policy.retry_on as e:
+            # attempts vs give-ups feed the run report's resilience summary
+            # (obs satellite: retries were previously visible only to the
+            # caller's on_retry log)
             if attempt >= len(delays):
+                obs_metrics.counter("resilience.retry.give_up").inc()
                 raise
             delay = delays[attempt]
             if slept + delay > policy.budget:
+                obs_metrics.counter("resilience.retry.give_up").inc()
                 raise
+            obs_metrics.counter("resilience.retry.attempt").inc()
             if on_retry is not None:
                 on_retry({
                     "attempt": attempt + 1,

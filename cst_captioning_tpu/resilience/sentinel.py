@@ -32,6 +32,8 @@ from typing import Any, Callable
 
 import jax
 
+from cst_captioning_tpu.obs import metrics as obs_metrics
+
 POLICIES = ("off", "skip_batch", "rollback", "abort")
 
 
@@ -114,12 +116,18 @@ class DivergenceSentinel:
         action = self.policy
         if kind == "spike" and self.policy == "skip_batch":
             action = "logged"
+        # every verdict counts, so a run report aggregates divergences even
+        # when the per-event log rotated away (obs satellite: log-only ->
+        # counted)
+        obs_metrics.counter(f"resilience.divergence.{kind}").inc()
         self.log(
             "divergence",
             phase=self.phase, step=step, loss=loss, kind=kind, action=action,
         )
         if self.policy == "skip_batch":
-            self.skipped += kind == "nonfinite"
+            if kind == "nonfinite":
+                self.skipped += 1
+                obs_metrics.counter("resilience.nan_skip").inc()
             return
         msg = f"{self.phase} step {step}: {kind} loss {loss!r}"
         if self.policy == "rollback":

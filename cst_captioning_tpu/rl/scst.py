@@ -28,6 +28,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cst_captioning_tpu import obs
 from cst_captioning_tpu.compat import pcast, shard_map
 from cst_captioning_tpu.config.config import RLConfig
 from cst_captioning_tpu.decoding import greedy_decode, sample_decode
@@ -464,17 +465,22 @@ class SCSTTrainer:
         """
         from cst_captioning_tpu.train import multihost
 
-        samples_np = multihost.to_host_local(          # [K, B_local, T]
-            samples, self.mesh, P(None, "data")
-        ) if self.mesh is not None else np.asarray(samples)
-        greedy_np = None
-        if greedy is not None:
-            greedy_np = multihost.to_host_local(
-                greedy, self.mesh, P("data")
-            ) if self.mesh is not None else np.asarray(greedy)
-        advantage, host_metrics = self._advantage(
-            greedy_np, samples_np, video_ids, valid_np
-        )
+        # the rl.reward span covers the device->host token readback AND the
+        # consensus scoring: this is the host half the pipeline must hide,
+        # so its p95 against rl.decode/rl.update is THE pipelining health
+        # signal in the run report
+        with obs.span("rl.reward"):
+            samples_np = multihost.to_host_local(          # [K, B_local, T]
+                samples, self.mesh, P(None, "data")
+            ) if self.mesh is not None else np.asarray(samples)
+            greedy_np = None
+            if greedy is not None:
+                greedy_np = multihost.to_host_local(
+                    greedy, self.mesh, P("data")
+                ) if self.mesh is not None else np.asarray(greedy)
+            advantage, host_metrics = self._advantage(
+                greedy_np, samples_np, video_ids, valid_np
+            )
         return (advantage, host_metrics, samples, feats, masks, valid_np)
 
     def _apply(self, state, advantage, host_metrics, samples, feats, masks,
@@ -482,12 +488,16 @@ class SCSTTrainer:
         """Device half: upload the advantage, dispatch the REINFORCE update."""
         from cst_captioning_tpu.train import multihost
 
-        adv = jnp.asarray(advantage, jnp.float32)
-        valid = jnp.asarray(valid_np)
-        if self.mesh is not None:
-            adv = multihost.from_host_local(adv, self.mesh, P(None, "data"))
-            valid = multihost.from_host_local(valid, self.mesh, P("data"))
-        state, metrics = self.update(state, feats, masks, samples, adv, valid)
+        # host time only: the update is dispatched, never waited on here
+        with obs.span("rl.update"):
+            adv = jnp.asarray(advantage, jnp.float32)
+            valid = jnp.asarray(valid_np)
+            if self.mesh is not None:
+                adv = multihost.from_host_local(adv, self.mesh, P(None, "data"))
+                valid = multihost.from_host_local(valid, self.mesh, P("data"))
+            state, metrics = self.update(
+                state, feats, masks, samples, adv, valid
+            )
         metrics = dict(metrics)
         metrics.update(host_metrics)
         return state, metrics
@@ -510,7 +520,8 @@ class SCSTTrainer:
 
     def train_step(self, state: TrainState, feats, masks, video_ids, rng,
                    valid=None):
-        greedy, samples = self.decode(state.params, feats, masks, rng)
+        with obs.span("rl.decode"):
+            greedy, samples = self.decode(state.params, feats, masks, rng)
         # sized from the LOCAL row count (== global single-host; under
         # multi-host, samples is a global array but the reward rows are ours)
         valid_np = self._valid_np(valid, len(video_ids))
@@ -586,15 +597,17 @@ class SCSTTrainer:
                 scored = None
                 emit(m)
             rng, srng = jax.random.split(rng)
-            d = self.decode(state.params, feats, masks, srng)
-            for arr in d:
-                # start the device->host token transfer NOW, so it overlaps
-                # this decode — by the time _score reads the tokens they are
-                # already on host. greedy is None for the scb/none baselines
-                # (no greedy rollout); multi-host global arrays are not fully
-                # addressable here and their reads go through to_host_local.
-                if arr is not None and arr.is_fully_addressable:
-                    arr.copy_to_host_async()
+            with obs.span("rl.decode"):
+                d = self.decode(state.params, feats, masks, srng)
+                for arr in d:
+                    # start the device->host token transfer NOW, so it
+                    # overlaps this decode — by the time _score reads the
+                    # tokens they are already on host. greedy is None for the
+                    # scb/none baselines (no greedy rollout); multi-host
+                    # global arrays are not fully addressable here and their
+                    # reads go through to_host_local.
+                    if arr is not None and arr.is_fully_addressable:
+                        arr.copy_to_host_async()
             if decoded is not None:
                 # host scores batch i-1 while the device runs update(i-2) +
                 # decode(i) queued above

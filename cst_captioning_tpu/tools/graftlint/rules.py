@@ -894,3 +894,54 @@ class SwallowedExceptionRule(Rule):
         return isinstance(stmt, ast.Expr) and isinstance(
             stmt.value, ast.Constant
         )
+
+
+# ---- GL010: ad-hoc timing / bare print in package hot paths -----------------
+
+# CLIs print their reports and the linter prints its findings — both are
+# user-facing stdout by design, not hot-path instrumentation
+_GL010_EXCLUDED = (
+    "cst_captioning_tpu/cli/", "cst_captioning_tpu/tools/",
+)
+
+
+@register
+class AdHocTimingRule(Rule):
+    id = "GL010"
+    name = "adhoc-timing-or-print-in-hot-path"
+    severity = "warning"
+    rationale = (
+        "hand-rolled time.time() deltas and bare print() in package code "
+        "are invisible to run reports and traces: time windows belong in "
+        "obs.span / obs.metrics, messages in EventLogger.log / obs.event"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # package code only (tests/benches/scripts measure and print on
+        # purpose), minus the user-facing CLI/tooling surfaces
+        return _in_package(ctx) and not ctx.relpath.startswith(_GL010_EXCLUDED)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d == "time.time":
+                out.append(ctx.finding(
+                    self, node,
+                    "raw time.time() in package code: wrap the window in "
+                    "obs.span(...) (or feed an obs.metrics histogram via "
+                    "time.perf_counter) so the duration reaches the run "
+                    "report and Perfetto trace; wall-clock event timestamps "
+                    "belong to EventLogger/obs",
+                ))
+            elif d == "print":
+                out.append(ctx.finding(
+                    self, node,
+                    "bare print() in package code: route it through "
+                    "EventLogger.log / obs.event so the message lands in "
+                    "the structured event stream instead of a scrollback "
+                    "buffer",
+                ))
+        return out

@@ -28,6 +28,7 @@ import threading
 import jax
 import numpy as np
 
+from cst_captioning_tpu import obs
 from cst_captioning_tpu.ckpt import CheckpointManager, load_params
 from cst_captioning_tpu.config.config import EvalConfig, ExperimentConfig
 from cst_captioning_tpu.data.batcher import Batcher
@@ -54,7 +55,7 @@ from cst_captioning_tpu.train.mesh import batch_sharding, make_mesh, replicate
 from cst_captioning_tpu.train.schedule import make_optimizer
 from cst_captioning_tpu.train.state import TrainState, create_train_state
 from cst_captioning_tpu.train.steps import batch_arrays, make_parallel_xe_step, make_xe_step
-from cst_captioning_tpu.utils.logging import EventLogger, StepTimer
+from cst_captioning_tpu.utils.logging import EventLogger
 from cst_captioning_tpu.utils.profiling import StepProfiler
 
 
@@ -68,6 +69,8 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     # run survives faults, not what it computes (on_divergence/spike_factor
     # DO alter numerics under faults, so those two stay drift-tracked)
     "train.ckpt_every_steps", "train.keep_ckpts", "train.max_rollbacks",
+    # observability plumbing: where the spans/metrics go, not what runs
+    "train.obs", "train.obs_dir",
     "eval.results_json",
 })
 
@@ -101,6 +104,21 @@ class Trainer:
         self.val_ds = val_ds
         self.model = CaptionModel(cfg.model)
         self.log = EventLogger(log_path)
+        if cfg.train.obs:
+            obs_dir = cfg.train.obs_dir or os.path.join(
+                cfg.train.ckpt_dir, "obs"
+            )
+            if multihost.is_multiprocess() and jax.process_index() != 0:
+                # one stream per process (same contract as the JSONL log)
+                obs_dir = os.path.join(obs_dir, f"proc{jax.process_index()}")
+            obs.configure(
+                obs_dir, run=cfg.name,
+                snapshot_every=cfg.train.log_every_steps,
+            )
+        # everything below (state init, resume restore, first collate) is
+        # run setup: give it a span so the report's phase totals account for
+        # the pre-training wall clock instead of reporting a coverage hole
+        setup_span = obs.span("setup").begin()
         if cfg.train.debug_nans:
             # sanitizer mode (SURVEY.md §5 row 2): every jitted step re-runs
             # eagerly on NaN production and raises at the originating op
@@ -217,6 +235,7 @@ class Trainer:
             if val_ds is not None
             else None
         )
+        setup_span.end()
 
     # ---- resume / handoff --------------------------------------------------
 
@@ -383,10 +402,11 @@ class Trainer:
         """Mid-epoch checkpoint (step-interval or preemption-triggered):
         records the exact batch index so resume replays the epoch remainder."""
         if jax.process_index() == 0:
-            self.ckpt.save_step(
-                jax.device_get(self.state), step_no,
-                self._ckpt_infos(phase, batch_index, step_no),
-            )
+            with obs.span("ckpt", kind="step"):
+                self.ckpt.save_step(
+                    jax.device_get(self.state), step_no,
+                    self._ckpt_infos(phase, batch_index, step_no),
+                )
         self.log.log(
             "ckpt_step", phase=phase, step=step_no, batch_index=batch_index,
         )
@@ -415,6 +435,7 @@ class Trainer:
         replayed epochs don't march straight back into the same poison batch
         sequence. Budgeted by ``train.max_rollbacks``."""
         self._rollbacks += 1
+        obs.counter("resilience.rollback").inc()
         if self._rollbacks > self.cfg.train.max_rollbacks:
             raise TrainingDiverged(
                 f"rollback budget exhausted ({self.cfg.train.max_rollbacks}) "
@@ -468,24 +489,25 @@ class Trainer:
         if epochs == 0:
             return None
         target = self.xe_epochs + epochs
-        timer = StepTimer()
+        meter = obs.StepMeter("xe")
         profiler = StepProfiler(
             os.path.join(cfg.train.profile_dir, "xe") if cfg.train.profile_dir
             else "",
             cfg.train.profile_steps,
+            log=self.log.log,
         )
         sentinel = self._make_sentinel("xe")
         last_val = None
-        run = {"first_step": True}  # compile-step timer exclusion, phase-wide
+        run = {"first_step": True}  # compile-step meter exclusion, phase-wide
         with PreemptionHandler() as pre:
             while self.xe_epochs < target:
                 try:
-                    last_val = self._xe_epoch(timer, profiler, sentinel, pre, run)
+                    last_val = self._xe_epoch(meter, profiler, sentinel, pre, run)
                 except RollbackRequested as e:
                     self._apply_rollback("xe", e, sentinel)
         return last_val
 
-    def _xe_epoch(self, timer, profiler, sentinel, pre, run) -> float | None:
+    def _xe_epoch(self, meter, profiler, sentinel, pre, run) -> float | None:
         """One XE epoch (possibly a resumed remainder): step loop, sentinel,
         mid-epoch saves, epoch-end validation + checkpoint."""
         cfg = self.cfg
@@ -501,58 +523,67 @@ class Trainer:
         # host-side step counter: reading int(self.state.step) per step in
         # the loop would block on the just-dispatched update every step
         step_no = int(self.state.step)  # graftlint: disable=GL001 (once per epoch)
-        timer.reset()
+        if obs.enabled():
+            obs.set_context(phase="xe", epoch=self.epoch + 1)
+        meter.begin_epoch()
         losses = []
         stop = threading.Event()
-        try:
-            for arrays in self._device_batches(self.batcher, skip=skip,
-                                               stop_event=stop):
-                feats, masks, labels, mask, weights, valid = arrays
-                # invalid rows get zero weight -> excluded from loss + norm
-                weights = valid if not weighted else weights * valid
-                self.state, m = self.xe_step(
-                    self.state, feats, masks, labels, mask, weights
-                )
-                # keep the device scalar: float() here would sync per step
-                # (graftlint GL001); the epoch summary reads them all back
-                # in one device_get
-                losses.append(m["loss"])
-                sentinel.push(step_no + 1, m["loss"], m.get("nonfinite"))
-                step_no += 1
-                batch_no += 1
-                if log_every and step_no % log_every == 0:
-                    # per-step event: a mid-epoch divergence (NaN, grad blowup)
-                    # is locatable from the log alone (SURVEY.md §5); the
-                    # float() syncs are gated — amortized over log_every steps
-                    self.log.log(
-                        "xe_step",
-                        phase="xe",
-                        step=step_no,
-                        epoch=self.epoch + 1,
-                        loss=float(m["loss"]),
-                        grad_norm=float(m["grad_norm"]),
-                    )
-                profiler.tick()
-                if run["first_step"]:
-                    # exclude jit-compile time from the throughput meter
-                    run["first_step"] = False
-                    timer.reset()
-                else:
-                    timer.tick(cfg.data.batch_size)
-                chaos.visit("xe.step")
-                if pre.requested:
-                    self._preempt_save("xe", step_no, batch_no, sentinel)
-                if ckpt_every and step_no % ckpt_every == 0:
-                    sentinel.flush()  # never save an update the policy rejects
-                    self._save_step_ckpt("xe", step_no, batch_no)
-        finally:
-            stop.set()
-        profiler.stop()
-        # a SIGTERM that lands between the last step and here must not let
-        # the epoch counters advance past the state actually saved
-        if pre.requested:
-            self._preempt_save("xe", step_no, batch_no, sentinel)
-        sentinel.flush()
+        # xe.step spans cover the loop body (dispatch + bookkeeping); the
+        # xe.epoch span's SELF time is therefore exactly the host's wait on
+        # the input pipeline — the report splits compute-bound from
+        # data-bound epochs without any extra probe
+        with obs.span("xe.epoch"):
+            try:
+                for arrays in self._device_batches(self.batcher, skip=skip,
+                                                   stop_event=stop):
+                    with obs.span("xe.step"):
+                        feats, masks, labels, mask, weights, valid = arrays
+                        # invalid rows get zero weight -> excluded from loss
+                        weights = valid if not weighted else weights * valid
+                        self.state, m = self.xe_step(
+                            self.state, feats, masks, labels, mask, weights
+                        )
+                        # keep the device scalar: float() here would sync per
+                        # step (graftlint GL001); the epoch summary reads
+                        # them all back in one device_get
+                        losses.append(m["loss"])
+                        sentinel.push(step_no + 1, m["loss"], m.get("nonfinite"))
+                        step_no += 1
+                        batch_no += 1
+                        if obs.enabled():
+                            obs.set_context(step=step_no)
+                        if log_every and step_no % log_every == 0:
+                            # per-step event: a mid-epoch divergence (NaN,
+                            # grad blowup) is locatable from the log alone
+                            # (SURVEY.md §5); the float() syncs are gated —
+                            # amortized over log_every steps
+                            self.log.log(
+                                "xe_step",
+                                phase="xe",
+                                step=step_no,
+                                epoch=self.epoch + 1,
+                                loss=float(m["loss"]),
+                                grad_norm=float(m["grad_norm"]),
+                            )
+                        obs.maybe_snapshot(step_no)
+                        profiler.tick()
+                        meter.tick(cfg.data.batch_size, first=run["first_step"])
+                        run["first_step"] = False
+                        chaos.visit("xe.step")
+                        if pre.requested:
+                            self._preempt_save("xe", step_no, batch_no, sentinel)
+                        if ckpt_every and step_no % ckpt_every == 0:
+                            # never save an update the policy rejects
+                            sentinel.flush()
+                            self._save_step_ckpt("xe", step_no, batch_no)
+            finally:
+                stop.set()
+            profiler.stop()
+            # a SIGTERM that lands between the last step and here must not let
+            # the epoch counters advance past the state actually saved
+            if pre.requested:
+                self._preempt_save("xe", step_no, batch_no, sentinel)
+            sentinel.flush()
         self.epoch += 1
         self.xe_epochs += 1
         vals = np.asarray(jax.device_get(losses), np.float64)  # graftlint: disable=GL001 (once per epoch)
@@ -562,8 +593,9 @@ class Trainer:
             epoch=self.epoch,
             # ONE readback for the whole epoch's loss scalars
             loss=float(vals.mean()) if vals.size else float("nan"),
-            clips_per_sec=timer.clips_per_sec,
+            **meter.epoch_summary(),
         )
+        obs.snapshot_metrics(epoch=self.epoch)
         return self._validate_and_checkpoint(step_no)
 
     def train_rl(self, epochs: int | None = None) -> float | None:
@@ -587,6 +619,7 @@ class Trainer:
             epochs = max(0, cfg.rl.epochs - self.rl_epochs)
         if epochs == 0:
             return None
+        rl_setup = obs.span("setup", phase="rl").begin()
         tx = make_optimizer(cfg.train, self.steps_per_epoch, lr_override=cfg.rl.lr)
         if self.rl_epochs == 0:
             # XE -> RL transition: fresh optimizer at RL LR (handoff semantics)
@@ -641,21 +674,23 @@ class Trainer:
         rl_batcher.salt = self.batcher.salt
         self._rl_batcher = rl_batcher
         target = self.rl_epochs + epochs
-        timer = StepTimer()
+        meter = obs.StepMeter("rl")
         profiler = StepProfiler(
             os.path.join(cfg.train.profile_dir, "rl") if cfg.train.profile_dir
             else "",
             cfg.train.profile_steps,
+            log=self.log.log,
         )
         sentinel = self._make_sentinel("rl")
         last_val = None
         run = {"first_step": True}
+        rl_setup.end()
         try:
             with PreemptionHandler() as pre:
                 while self.rl_epochs < target:
                     try:
                         last_val = self._rl_epoch(
-                            scst, rl_batcher, timer, profiler, sentinel, pre,
+                            scst, rl_batcher, meter, profiler, sentinel, pre,
                             run,
                         )
                     except RollbackRequested as e:
@@ -664,7 +699,7 @@ class Trainer:
             self._rl_batcher = None
         return last_val
 
-    def _rl_epoch(self, scst, rl_batcher, timer, profiler, sentinel, pre,
+    def _rl_epoch(self, scst, rl_batcher, meter, profiler, sentinel, pre,
                   run) -> float | None:
         """One RL epoch (possibly a resumed remainder)."""
         cfg = self.cfg
@@ -690,7 +725,9 @@ class Trainer:
             ep_rng = jax.random.split(ep_rng)[0]
         step_counter = {"step": int(self.state.step)}  # graftlint: disable=GL001 (once per epoch)
         batch_counter = {"n": skip}
-        timer.reset()
+        if obs.enabled():
+            obs.set_context(phase="rl", epoch=self.epoch + 1)
+        meter.begin_epoch()
         rewards = []
         valid_rows = []
 
@@ -702,6 +739,8 @@ class Trainer:
             sentinel.push(
                 step_counter["step"], m["rl_loss"], m.get("nonfinite")
             )
+            if obs.enabled():
+                obs.set_context(step=step_counter["step"])
             if log_every and step_counter["step"] % log_every == 0:
                 self.log.log(
                     "rl_step",
@@ -712,12 +751,10 @@ class Trainer:
                     rl_loss=float(m["rl_loss"]),
                     grad_norm=float(m["grad_norm"]),
                 )
+            obs.maybe_snapshot(step_counter["step"])
             profiler.tick()
-            if run["first_step"]:
-                run["first_step"] = False
-                timer.reset()  # exclude jit-compile time of the first step
-            else:
-                timer.tick(cfg.data.batch_size)
+            meter.tick(cfg.data.batch_size, first=run["first_step"])
+            run["first_step"] = False
             chaos.visit("rl.step")
 
         # pipelined epoch (rl.pipelined, default): host reward for batch i
@@ -726,24 +763,28 @@ class Trainer:
         # should_stop: a SIGTERM stops consuming at the next batch boundary
         # and the pipeline drains, so state == batch_counter steps exactly
         stop = threading.Event()
-        try:
-            self.state, _ = scst.train_epoch(
-                self.state,
-                self._rl_device_batches(rl_batcher, skip=skip,
-                                        stop_event=stop),
-                ep_rng,
-                on_step=on_step,
-                pipelined=cfg.rl.pipelined,
-                should_stop=lambda: pre.requested,
-            )
-        finally:
-            stop.set()
-        profiler.stop()
-        if pre.requested:
-            self._preempt_save(
-                "rl", step_counter["step"], batch_counter["n"], sentinel
-            )
-        sentinel.flush()
+        # the rl.epoch span's self time is everything the decode/reward/
+        # update spans inside scst.train_epoch don't claim: input-pipeline
+        # waits, rng bookkeeping, drain stalls
+        with obs.span("rl.epoch"):
+            try:
+                self.state, _ = scst.train_epoch(
+                    self.state,
+                    self._rl_device_batches(rl_batcher, skip=skip,
+                                            stop_event=stop),
+                    ep_rng,
+                    on_step=on_step,
+                    pipelined=cfg.rl.pipelined,
+                    should_stop=lambda: pre.requested,
+                )
+            finally:
+                stop.set()
+            profiler.stop()
+            if pre.requested:
+                self._preempt_save(
+                    "rl", step_counter["step"], batch_counter["n"], sentinel
+                )
+            sentinel.flush()
         self.epoch += 1
         self.rl_epochs += 1
         n_valid = float(np.sum(valid_rows)) if valid_rows else 0.0
@@ -758,8 +799,9 @@ class Trainer:
                 float(np.dot(rewards, valid_rows)) if valid_rows else 0.0,
                 n_valid,
             ),
-            clips_per_sec=timer.clips_per_sec,
+            **meter.epoch_summary(),
         )
+        obs.snapshot_metrics(epoch=self.epoch)
         return self._validate_and_checkpoint(step_counter["step"])
 
     # ---- validation --------------------------------------------------------
@@ -777,14 +819,16 @@ class Trainer:
             self.log.log("validate", epoch=self.epoch, cider_d=value)
         if jax.process_index() != 0:
             return value
-        is_best = self.ckpt.save(
-            jax.device_get(self.state),
-            value,
-            # full config snapshot: the reference's `infos` pickle carried the
-            # whole opt namespace (SURVEY.md §5 checkpoint row); global_step/
-            # phase/batch_index/data_salt feed mid-epoch resume ordering
-            infos=self._ckpt_infos(step_no=step_no),
-        )
+        with obs.span("ckpt", kind="epoch"):
+            is_best = self.ckpt.save(
+                jax.device_get(self.state),
+                value,
+                # full config snapshot: the reference's `infos` pickle carried
+                # the whole opt namespace (SURVEY.md §5 checkpoint row);
+                # global_step/phase/batch_index/data_salt feed mid-epoch
+                # resume ordering
+                infos=self._ckpt_infos(step_no=step_no),
+            )
         if is_best:
             self.log.log("new_best", epoch=self.epoch, cider_d=value)
         return value

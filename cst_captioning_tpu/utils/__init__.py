@@ -1,5 +1,8 @@
-"""Utilities: structured logging, timing (reference ``utils.py``, row 13)."""
+"""Utilities: structured logging, profiling (reference ``utils.py``, row 13).
 
-from cst_captioning_tpu.utils.logging import EventLogger, StepTimer
+Step timing lives in :mod:`cst_captioning_tpu.obs.metrics` (``StepMeter``).
+"""
 
-__all__ = ["EventLogger", "StepTimer"]
+from cst_captioning_tpu.utils.logging import EventLogger
+
+__all__ = ["EventLogger"]

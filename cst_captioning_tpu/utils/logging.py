@@ -76,20 +76,7 @@ class EventLogger:
         return False
 
 
-class StepTimer:
-    """Running clips/sec meter (the north-star throughput counter)."""
-
-    def __init__(self):
-        self.reset()
-
-    def reset(self):
-        self._t0 = time.perf_counter()
-        self._clips = 0
-
-    def tick(self, clips: int):
-        self._clips += clips
-
-    @property
-    def clips_per_sec(self) -> float:
-        dt = time.perf_counter() - self._t0
-        return self._clips / dt if dt > 0 else 0.0
+# StepTimer (the old private clips/sec meter) is gone: both trainer phases
+# meter through obs.metrics.StepMeter — one latency histogram + throughput
+# counter per phase on the process-wide registry, so XE and RL epochs report
+# identically and the run report sees the same numbers the log does.

@@ -4,13 +4,21 @@ The reference had no profiling at all (SURVEY.md §5 row 1: ad-hoc
 ``time.time()`` prints); here a trace of N post-compile steps can be captured
 to a directory viewable in TensorBoard/Perfetto, wired through
 ``TrainConfig.profile_dir`` / ``--profile``.
+
+Observability wiring (obs/ package): the capture window opens an obs span on
+the ``profiler`` virtual track, so the window shows up on the run timeline
+next to the step spans it overlaps, and completion is announced as a
+structured ``profiler_trace_written`` event (through the caller's event
+logger when given one, and into the obs stream) instead of a stderr print.
 """
 
 from __future__ import annotations
 
-import sys
+from typing import Callable
 
 import jax
+
+from cst_captioning_tpu import obs
 
 
 class StepProfiler:
@@ -19,15 +27,22 @@ class StepProfiler:
     ``tick()`` is called once per finished training step; the first ``skip``
     steps are excluded so jit compilation doesn't dominate the trace. Safe to
     leave in hot loops when disabled (``out_dir=""`` -> every tick is a no-op).
+
+    ``log(event, **fields)`` (an ``EventLogger.log`` works as-is) receives
+    the ``profiler_trace_written`` completion event; the obs stream gets a
+    copy regardless, so run reports can count capture windows.
     """
 
-    def __init__(self, out_dir: str, steps: int = 10, skip: int = 1):
+    def __init__(self, out_dir: str, steps: int = 10, skip: int = 1,
+                 log: Callable[..., None] | None = None):
         self.out_dir = out_dir
         self.steps = steps
         self.skip = skip
+        self._log = log
         self._count = 0
         self._active = False
         self._done = not out_dir
+        self._span: obs.Span | None = None
 
     def tick(self) -> None:
         if self._done:
@@ -36,6 +51,11 @@ class StepProfiler:
         if not self._active and self._count > self.skip:
             jax.profiler.start_trace(self.out_dir)
             self._active = True
+            # virtual track: the window spans several steps, so it must not
+            # join the caller thread's (properly nested) span stack
+            self._span = obs.span(
+                "profile.window", track="profiler", dir=self.out_dir
+            ).begin()
             self._stop_at = self._count + self.steps
         elif self._active and self._count >= self._stop_at:
             self.stop()
@@ -45,5 +65,11 @@ class StepProfiler:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
-            print(f"[profile] trace written to {self.out_dir}", file=sys.stderr)
+            if self._span is not None:
+                self._span.end()
+                self._span = None
+            fields = {"dir": self.out_dir, "steps": self.steps}
+            obs.event("profiler_trace_written", **fields)
+            if self._log is not None:
+                self._log("profiler_trace_written", **fields)
         self._done = True

@@ -20,4 +20,9 @@ python -m cst_captioning_tpu.tools.graftlint \
 python -m compileall -q cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_recipe.py
 
+# obs_report smoke check: the report CLI must aggregate a known-good run dir
+# without a jax import or backend init (it is part of the operator loop for
+# dead runs — it has to work on a box with nothing but the repo)
+python -m cst_captioning_tpu.cli.obs_report tests/fixtures/obs_run > /dev/null
+
 echo "lint.sh: OK"

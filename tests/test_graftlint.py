@@ -480,6 +480,67 @@ def test_gl009_not_applied_outside_package(tmp_path):
     assert findings == []
 
 
+# ---- GL010: ad-hoc timing / bare print in package hot paths -----------------
+
+def test_gl010_positive_time_time_in_package(tmp_path):
+    findings = _lint(
+        tmp_path, "cst_captioning_tpu/train/fake.py", (
+            "import time\n"
+            "def epoch(step, batches):\n"
+            "    t0 = time.time()\n"
+            "    for b in batches:\n"
+            "        step(b)\n"
+            "    return time.time() - t0\n"
+        ), rules=["GL010"],
+    )
+    assert _rules_of(findings) == ["GL010"]
+    assert len(findings) == 2 and findings[0].severity == "warning"
+    assert "obs.span" in findings[0].message
+
+
+def test_gl010_positive_bare_print_in_package(tmp_path):
+    findings = _lint(
+        tmp_path, "cst_captioning_tpu/rl/fake.py", (
+            "def score(rows):\n"
+            "    print('scored', len(rows))\n"
+        ), rules=["GL010"],
+    )
+    assert _rules_of(findings) == ["GL010"]
+    assert "EventLogger" in findings[0].message
+
+
+def test_gl010_negative_perf_counter_and_obs_span(tmp_path):
+    # the prescribed replacements never trip the rule
+    findings = _lint(
+        tmp_path, "cst_captioning_tpu/train/fake.py", (
+            "import time\n"
+            "from cst_captioning_tpu import obs\n"
+            "def epoch(step, batches):\n"
+            "    t0 = time.perf_counter()\n"
+            "    with obs.span('xe.epoch'):\n"
+            "        for b in batches:\n"
+            "            step(b)\n"
+            "    obs.event('done', dur=time.perf_counter() - t0)\n"
+        ), rules=["GL010"],
+    )
+    assert findings == []
+
+
+def test_gl010_not_applied_to_clis_tools_tests(tmp_path):
+    # user-facing stdout surfaces and tests print/measure on purpose
+    for rel in ("cst_captioning_tpu/cli/fake.py",
+                "cst_captioning_tpu/tools/graftlint/fake.py",
+                "tests/test_fake.py", "scripts/fake.py", "bench_fake.py"):
+        findings = _lint(
+            tmp_path, rel, (
+                "import time\n"
+                "def main():\n"
+                "    print(time.time())\n"
+            ), rules=["GL010"],
+        )
+        assert findings == [], rel
+
+
 # ---- suppressions -----------------------------------------------------------
 
 def test_inline_suppression_same_line(tmp_path):
@@ -608,11 +669,11 @@ def test_cli_write_baseline_then_clean(tmp_path, capsys):
     assert cli_main([str(path), "--root", str(tmp_path)]) == 0
 
 
-def test_cli_list_rules_names_all_nine(tmp_path, capsys):
+def test_cli_list_rules_names_all_ten(tmp_path, capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008", "GL009"):
+                "GL007", "GL008", "GL009", "GL010"):
         assert rid in out
 
 

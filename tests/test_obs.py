@@ -1,0 +1,488 @@
+"""Observability subsystem: spans, metrics, Prometheus export, run reports.
+
+Covers the obs/ acceptance surface: span nesting/ordering/self-time, the
+thread-local context, histogram bucket math, the Prometheus textfile format,
+report aggregation from a synthetic event file (the committed fixture
+scripts/lint.sh also smokes), disabled-mode no-op (zero events, zero files),
+profiler event routing, and a real chaos run whose report shows the
+injected faults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu import obs
+from cst_captioning_tpu.obs.metrics import Histogram, Registry, StepMeter
+from cst_captioning_tpu.obs.report import (
+    build_report,
+    render_report,
+    report_run,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_RUN = os.path.join(REPO, "tests", "fixtures", "obs_run")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Obs state is process-global: every test starts and ends detached."""
+    obs.shutdown()
+    obs.REGISTRY.reset()
+    yield
+    obs.shutdown()
+    obs.REGISTRY.reset()
+
+
+def read_events(run_dir):
+    with open(os.path.join(run_dir, "events.jsonl")) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+def spans_of(events, name=None):
+    out = [e for e in events if e["event"] == "span"]
+    return [e for e in out if e["name"] == name] if name else out
+
+
+# ---- spans ------------------------------------------------------------------
+
+def test_span_nesting_ordering_and_self_time(tmp_path):
+    obs.configure(str(tmp_path / "run"), run="t")
+    with obs.span("outer"):
+        time.sleep(0.02)
+        with obs.span("inner", tag="a"):
+            time.sleep(0.03)
+        time.sleep(0.0)
+    obs.shutdown()
+    events = read_events(str(tmp_path / "run"))
+    assert events[0]["event"] == "run_start"
+    assert events[-1]["event"] == "run_end"
+    sp = spans_of(events)
+    # inner finishes (and is therefore emitted) before outer
+    assert [s["name"] for s in sp] == ["inner", "outer"]
+    inner, outer = sp
+    assert inner["depth"] == 1 and inner["parent"] == "outer"
+    assert inner["tag"] == "a"
+    assert outer["depth"] == 0 and "parent" not in outer
+    assert outer["dur"] >= inner["dur"] >= 0.03
+    # self time excludes the child exactly
+    assert outer["self_dur"] == pytest.approx(
+        outer["dur"] - inner["dur"], abs=1e-6
+    )
+    assert inner["self_dur"] == pytest.approx(inner["dur"], abs=1e-6)
+
+
+def test_span_context_fields_attach_and_detach(tmp_path):
+    obs.configure(str(tmp_path / "run"), run="t")
+    obs.set_context(phase="xe", epoch=3, step=7)
+    with obs.span("a"):
+        pass
+    obs.set_context(step=None)
+    obs.event("ping")
+    obs.shutdown()
+    events = read_events(str(tmp_path / "run"))
+    (a,) = spans_of(events, "a")
+    assert (a["phase"], a["epoch"], a["step"]) == ("xe", 3, 7)
+    (ping,) = [e for e in events if e["event"] == "ping"]
+    assert ping["phase"] == "xe" and "step" not in ping
+
+
+def test_span_attr_never_shadows_schema(tmp_path):
+    obs.configure(str(tmp_path / "run"), run="t")
+    with obs.span("ckpt.save", name="latest", dur="shadow"):
+        pass
+    obs.shutdown()
+    (s,) = spans_of(read_events(str(tmp_path / "run")), "ckpt.save")
+    assert s["name"] == "ckpt.save" and isinstance(s["dur"], float)
+    assert s["attr_name"] == "latest" and s["attr_dur"] == "shadow"
+
+
+def test_trace_json_is_perfetto_compatible(tmp_path):
+    obs.configure(str(tmp_path / "run"), run="t")
+    with obs.span("outer"):
+        with obs.span("inner"):
+            pass
+    w = obs.span("window", track="mytrack").begin()
+    w.end()
+    obs.shutdown()
+    doc = json.load(open(tmp_path / "run" / "trace.json"))
+    evs = doc["traceEvents"]
+    assert {e["name"] for e in evs} == {"outer", "inner", "window"}
+    for e in evs:
+        assert e["ph"] == "X"
+        assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    (win,) = [e for e in evs if e["name"] == "window"]
+    assert win["tid"] == "mytrack"  # virtual track, not the thread
+
+
+def test_disabled_mode_is_a_noop(tmp_path):
+    """train.obs off: zero events, zero files, shared no-op span object."""
+    assert obs.configure(str(tmp_path / "off"), enabled=False) is None
+    assert not obs.enabled()
+    s1, s2 = obs.span("a", big=1), obs.span("b")
+    assert s1 is s2  # the shared singleton: no allocation per call
+    with s1:
+        pass
+    obs.event("nope", x=1)
+    obs.snapshot_metrics()
+    obs.maybe_snapshot(100)
+    assert not os.path.exists(tmp_path / "off")
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_span_survives_foreign_stack_state(tmp_path):
+    """A begin() left open (crash path) degrades accounting, never corrupts."""
+    obs.configure(str(tmp_path / "run"), run="t")
+    leaked = obs.span("leaked").begin()
+    with obs.span("ok"):
+        pass
+    # ending the outer leaked span pops past the already-finished child
+    leaked.end()
+    obs.shutdown()
+    names = [s["name"] for s in spans_of(read_events(str(tmp_path / "run")))]
+    assert names == ["ok", "leaked"]
+
+
+# ---- metrics ----------------------------------------------------------------
+
+def test_histogram_bucket_math():
+    h = Histogram("t", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    assert h.counts == [1, 1, 1, 1]
+    assert h.count == 4
+    assert h.sum == pytest.approx(105.0)
+    assert h.max == 100.0
+    # boundary lands in the bucket it bounds (le semantics)
+    h2 = Histogram("t2", buckets=(1.0, 2.0))
+    h2.observe(1.0)
+    assert h2.counts == [1, 0, 0]
+    # interpolated quantiles: rank 2 of 4 tops out bucket (1, 2]
+    assert h.quantile(0.5) == pytest.approx(2.0)
+    assert h.quantile(1.0) == 100.0  # overflow bucket reports the exact max
+    assert h.quantile(0.0) == pytest.approx(0.5, abs=0.5)
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    with pytest.raises(ValueError):
+        Histogram("bad", buckets=(2.0, 1.0))
+
+
+def test_registry_kinds_and_conflicts():
+    reg = Registry()
+    c = reg.counter("a.b")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    reg.gauge("g").set(7)
+    with pytest.raises(TypeError):
+        reg.counter("g")  # name already registered as a gauge
+    snap = reg.snapshot()
+    assert snap["counters"]["a.b"] == 3.5
+    assert snap["gauges"]["g"] == 7.0
+
+
+def test_prometheus_textfile_format():
+    reg = Registry()
+    reg.counter("resilience.nan_skip").inc(3)
+    reg.gauge("prefetch.queue_depth").set(2)
+    h = reg.histogram("xe.step_seconds", buckets=(0.1, 0.5))
+    for v in (0.05, 0.3, 2.0):
+        h.observe(v)
+    text = reg.to_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE resilience_nan_skip counter" in lines
+    assert "resilience_nan_skip 3" in lines
+    assert "prefetch_queue_depth 2" in lines
+    assert "# TYPE xe_step_seconds histogram" in lines
+    # cumulative buckets + +Inf == count
+    assert 'xe_step_seconds_bucket{le="0.1"} 1' in lines
+    assert 'xe_step_seconds_bucket{le="0.5"} 2' in lines
+    assert 'xe_step_seconds_bucket{le="+Inf"} 3' in lines
+    assert "xe_step_seconds_count 3" in lines
+    assert any(l.startswith("xe_step_seconds_sum 2.35") for l in lines)
+    assert text.endswith("\n")
+
+
+def test_step_meter_windows_and_compile_exclusion():
+    meter = StepMeter("tmeter")
+    meter.begin_epoch()
+    meter.tick(8, first=True)   # compile step: excluded from the histogram
+    time.sleep(0.01)
+    meter.tick(8)
+    meter.tick(8)
+    s = meter.epoch_summary()
+    assert s["steps"] == 2.0
+    assert meter.clips.value == 16.0
+    assert meter.compile_secs.value > 0.0
+    assert meter.hist.count == 2
+    assert s["clips_per_sec"] > 0.0
+    # the next epoch windows its own deltas
+    meter.begin_epoch()
+    meter.tick(8)
+    assert meter.epoch_summary()["steps"] == 1.0
+
+
+def test_metrics_snapshot_lands_in_event_stream(tmp_path):
+    obs.configure(str(tmp_path / "run"), run="t", snapshot_every=2)
+    obs.counter("resilience.rollback").inc()
+    obs.maybe_snapshot(1)   # off-cadence: no snapshot
+    obs.maybe_snapshot(2)   # on-cadence
+    obs.shutdown()          # final snapshot
+    events = read_events(str(tmp_path / "run"))
+    snaps = [e for e in events if e["event"] == "metrics"]
+    assert len(snaps) == 2 and snaps[0]["step"] == 2
+    assert snaps[-1]["final"] is True
+    assert snaps[-1]["counters"]["resilience.rollback"] == 1
+    # the Prometheus textfile is (re)written by snapshots
+    prom = open(tmp_path / "run" / "metrics.prom").read()
+    assert "resilience_rollback 1" in prom
+
+
+# ---- profiler routing (satellite 1) -----------------------------------------
+
+def test_step_profiler_routes_through_event_stream(tmp_path, monkeypatch):
+    import jax
+
+    from cst_captioning_tpu.utils.profiling import StepProfiler
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    obs.configure(str(tmp_path / "run"), run="t")
+    logged = []
+    prof = StepProfiler(str(tmp_path / "trace"), steps=2, skip=1,
+                        log=lambda ev, **f: logged.append((ev, f)))
+    for _ in range(5):
+        prof.tick()
+    assert calls == [("start", str(tmp_path / "trace")), ("stop",)]
+    obs.shutdown()
+    # no stderr print: completion is a structured event, to BOTH sinks
+    assert logged == [(
+        "profiler_trace_written",
+        {"dir": str(tmp_path / "trace"), "steps": 2},
+    )]
+    events = read_events(str(tmp_path / "run"))
+    assert [e for e in events if e["event"] == "profiler_trace_written"]
+    # the capture window is a span on the profiler virtual track
+    (win,) = spans_of(events, "profile.window")
+    assert win["track"] == "profiler"
+
+
+# ---- report -----------------------------------------------------------------
+
+def test_report_aggregates_committed_fixture():
+    rep = report_run(FIXTURE_RUN)
+    assert rep["run"] == "fixture" and rep["complete"]
+    assert rep["wall_s"] == pytest.approx(7.5)
+    by_name = {p["phase"]: p for p in rep["phases"]}
+    assert by_name["xe.step"]["count"] == 2
+    assert by_name["xe.step"]["total_s"] == pytest.approx(0.9)
+    assert by_name["xe.step"]["max_s"] == pytest.approx(0.5)
+    # totals partition: covered == sum of self times, and the epoch spans
+    # contribute only their input-wait self time
+    assert rep["covered_s"] == pytest.approx(
+        sum(p["self_s"] for p in rep["phases"])
+    )
+    assert by_name["xe.epoch"]["self_s"] == pytest.approx(1.1)
+    assert rep["coverage"] == pytest.approx(6.4 / 7.5)
+    # background work is reported but never summed against wall clock
+    over = {p["phase"] for p in rep["overlap"]}
+    assert over == {"prefetch.stage", "profile.window"}
+    r = rep["resilience"]
+    assert r["nan_skips"] == 1 and r["divergences"] == 2
+    assert r["rollbacks"] == 1 and r["retry_attempts"] == 2
+    assert r["ckpt_corrupt_fallbacks"] == 1
+    assert r["chaos_faults"] == 3
+    assert r["chaos_faults_by_kind"] == {"nan": 2, "io_error": 1}
+    assert rep["compile"] == {"count": 4, "seconds": 2.5}
+    text = render_report(rep)
+    assert "xe.step" in text and "chaos faults injected: 3" in text
+    assert "nan=2" in text and "rollbacks: 1" in text
+
+
+def test_report_handles_torn_stream_and_missing_end(tmp_path):
+    d = tmp_path / "run"
+    d.mkdir()
+    lines = [
+        json.dumps({"ts": 10.0, "event": "run_start", "run": "torn",
+                    "thread": "MainThread"}),
+        json.dumps({"ts": 11.0, "event": "span", "name": "xe.step",
+                    "dur": 1.0, "self_dur": 1.0, "depth": 0,
+                    "thread": "MainThread"}),
+        '{"ts": 12.0, "event": "span", "na',  # torn final line (kill -9)
+    ]
+    (d / "events.jsonl").write_text("\n".join(lines))
+    # build_report over hand-parsed events == report_run over the torn file
+    assert build_report([json.loads(l) for l in lines[:2]])["wall_s"] == 1.0
+    rep = report_run(str(d))
+    assert not rep["complete"]
+    assert rep["wall_s"] == pytest.approx(1.0)  # first..last parseable ts
+    assert rep["phases"][0]["phase"] == "xe.step"
+    assert "did not close cleanly" in render_report(rep)
+
+
+def test_report_missing_dir_errors_cleanly(tmp_path):
+    from cst_captioning_tpu.cli.obs_report import main as report_main
+
+    assert report_main([str(tmp_path / "nope")]) == 2
+    with pytest.raises(FileNotFoundError):
+        report_run(str(tmp_path / "nope"))
+
+
+def test_obs_report_cli_json(tmp_path, capsys):
+    from cst_captioning_tpu.cli.obs_report import main as report_main
+
+    assert report_main([FIXTURE_RUN, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["run"] == "fixture"
+    assert {p["phase"] for p in rep["phases"]} >= {"xe.step", "rl.reward"}
+    capsys.readouterr()
+    assert report_main([FIXTURE_RUN]) == 0
+    assert "resilience:" in capsys.readouterr().out
+
+
+def test_live_roundtrip_report_covers_wall_clock(tmp_path):
+    """Recorder -> stream -> report: coverage ~1 for fully spanned runs."""
+    obs.configure(str(tmp_path / "run"), run="t")
+    with obs.span("xe.epoch"):
+        for _ in range(3):
+            with obs.span("xe.step"):
+                time.sleep(0.01)
+    with obs.span("eval"):
+        time.sleep(0.02)
+    obs.shutdown()
+    rep = report_run(str(tmp_path / "run"))
+    assert rep["complete"]
+    by_name = {p["phase"]: p for p in rep["phases"]}
+    assert by_name["xe.step"]["count"] == 3
+    # phase totals sum to (nearly) the measured wall clock
+    assert rep["coverage"] > 0.9
+    assert rep["covered_s"] <= rep["wall_s"] + 1e-6
+
+
+# ---- chaos-run report (satellite: injected faults are visible) --------------
+
+@pytest.fixture(scope="module")
+def chaos_datasets(tmp_path_factory):
+    from cst_captioning_tpu.data import CaptionDataset, make_synthetic_dataset
+
+    out = tmp_path_factory.mktemp("obssynth")
+    synth = make_synthetic_dataset(
+        str(out), num_videos=12, num_topics=3, vocab_words=20,
+        modalities={"resnet": 16}, max_frames=4, seed=5,
+    )
+    train = CaptionDataset(
+        synth["info_json"], {"resnet": synth["resnet"]}, "train", 4
+    )
+    return train
+
+
+def test_chaos_run_report_shows_injected_faults(chaos_datasets, tmp_path):
+    from cst_captioning_tpu.config.config import (
+        DataConfig,
+        EvalConfig,
+        ExperimentConfig,
+        ModelConfig,
+        RLConfig,
+        TrainConfig,
+    )
+    from cst_captioning_tpu.resilience import Fault, FaultPlan
+    from cst_captioning_tpu.train.trainer import Trainer
+
+    train_ds = chaos_datasets
+    ckpt = str(tmp_path / "ckpt")
+    run_dir = str(tmp_path / "obs")
+    cfg = ExperimentConfig(
+        name="obs-chaos",
+        model=ModelConfig(
+            vocab_size=len(train_ds.vocab), modalities=(("resnet", 16),),
+            d_embed=16, d_hidden=16, d_att=8, encoder="temporal_attention",
+            dropout=0.0, max_len=8, max_frames=4, dtype="float32",
+        ),
+        data=DataConfig(batch_size=8, seq_per_vid=2),
+        train=TrainConfig(
+            lr=5e-3, grad_clip=5.0, ckpt_dir=ckpt, seed=0, epochs=1,
+            eval_every_epochs=100, log_every_steps=1,
+            obs=True, obs_dir=run_dir,
+        ),
+        rl=RLConfig(enabled=False),
+        eval=EvalConfig(beam_size=1, max_len=8),
+    )
+    tr = Trainer(cfg, train_ds, None, log_path=ckpt + "/ev.jsonl",
+                 use_mesh=False)
+    plan = FaultPlan([Fault("xe.batch", "nan", at=1)])
+    with plan.activate():
+        tr.train_xe()
+    obs.shutdown()
+    assert plan.fired
+
+    rep = report_run(run_dir)
+    by_name = {p["phase"]: p for p in rep["phases"]}
+    # the instrumented run produced the phase table...
+    assert by_name["xe.step"]["count"] == 3
+    assert "setup" in by_name and "ckpt.save" in by_name
+    assert rep["coverage"] > 0.5
+    # ...and the resilience summary shows the injected fault end to end:
+    # chaos activation -> device guard nan-skip -> sentinel verdict
+    r = rep["resilience"]
+    assert r["chaos_faults"] >= 1
+    assert r["chaos_faults_by_kind"].get("nan", 0) >= 1
+    assert r["nan_skips"] == 1
+    assert r["divergences"] == 1
+    text = render_report(rep)
+    assert "nan-skips: 1" in text
+
+
+def test_trainer_epoch_events_report_meter_latency(chaos_datasets, tmp_path):
+    """Satellite: XE epochs log obs-histogram latency (the StepTimer
+    replacement) — identical field names to the RL epoch summary."""
+    from cst_captioning_tpu.config.config import (
+        DataConfig,
+        EvalConfig,
+        ExperimentConfig,
+        ModelConfig,
+        RLConfig,
+        TrainConfig,
+    )
+    from cst_captioning_tpu.train.trainer import Trainer
+
+    train_ds = chaos_datasets
+    ckpt = str(tmp_path / "ckpt")
+    cfg = ExperimentConfig(
+        name="meter",
+        model=ModelConfig(
+            vocab_size=len(train_ds.vocab), modalities=(("resnet", 16),),
+            d_embed=16, d_hidden=16, d_att=8, encoder="meanpool",
+            dropout=0.0, max_len=8, max_frames=4, dtype="float32",
+        ),
+        data=DataConfig(batch_size=8, seq_per_vid=2),
+        train=TrainConfig(
+            lr=5e-3, ckpt_dir=ckpt, seed=0, epochs=1, eval_every_epochs=100,
+        ),
+        rl=RLConfig(enabled=True, num_rollouts=2, lr=1e-3, epochs=1,
+                    baseline="greedy", pipelined=False),
+        eval=EvalConfig(beam_size=1, max_len=8),
+    )
+    tr = Trainer(cfg, train_ds, None, log_path=ckpt + "/ev.jsonl",
+                 use_mesh=False)
+    tr.train_xe()
+    tr.train_rl()
+    events = [json.loads(l) for l in open(ckpt + "/ev.jsonl")]
+    (xe,) = [e for e in events if e["event"] == "xe_epoch"]
+    (rl,) = [e for e in events if e["event"] == "rl_epoch"]
+    keys = {"steps", "clips_per_sec", "step_seconds_p50", "step_seconds_p95"}
+    assert keys <= set(xe) and keys <= set(rl)
+    assert xe["steps"] == 3.0 - 1.0  # first (compile) step excluded
+    assert xe["clips_per_sec"] > 0 and rl["clips_per_sec"] > 0
+    assert np.isfinite(xe["step_seconds_p95"])
