@@ -51,6 +51,12 @@ import time
 
 import numpy as np
 
+from cst_captioning_tpu.obs.flops import (   # pure stdlib — no jax import
+    enc_and_per_tok_flops as _shared_enc_per_tok,
+    peak_flops as _peak_flops,
+    peak_hbm as _peak_hbm,
+)
+
 ASSUMED_REFERENCE_CLIPS_PER_SEC = 100.0   # 2017 single-GPU estimate (see above)
 TARGET_MULTIPLIER = 3.0
 
@@ -77,39 +83,8 @@ VOCAB = 9000
 MEASURE_STEPS = 16
 WARMUP_STEPS = 2
 
-# peak dense bf16 FLOP/s and HBM bandwidth per chip by device kind (public
-# TPU specs); the match is substring-based and the assumed values are carried
-# in the JSON
-PEAK_BF16_FLOPS = (
-    ("v6e", 918e12), ("v6 lite", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
-    ("v4", 275e12),
-)
-DEFAULT_PEAK = 197e12
-PEAK_HBM_BYTES = (
-    ("v6e", 1640e9), ("v6 lite", 1640e9),
-    ("v5p", 2765e9),
-    ("v5e", 819e9), ("v5 lite", 819e9), ("v5litepod", 819e9),
-    ("v4", 1228e9),
-)
-DEFAULT_PEAK_HBM = 819e9
-
-
-def _peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for frag, peak in PEAK_BF16_FLOPS:
-        if frag in kind:
-            return peak
-    return DEFAULT_PEAK
-
-
-def _peak_hbm(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for frag, peak in PEAK_HBM_BYTES:
-        if frag in kind:
-            return peak
-    return DEFAULT_PEAK_HBM
+# peak-rate tables and the matmul cost model live in obs/flops.py (shared
+# with bench_decode.py and the run report's MFU column) — imported above
 
 
 def _force_cpu_mesh(environ, n: int) -> None:
@@ -188,17 +163,8 @@ def _enc_and_per_tok_flops(
     F=FRAMES, d=512, d_att=256, V=VOCAB, feat_dims=(2048, 500)
 ) -> tuple[float, float]:
     """(encoder-pass, per-decoded-token) matmul FLOPs of the flagship model
-    — the shared cost model for the RL and XE benches."""
-    M = len(feat_dims) * F
-    enc = 2 * F * sum(feat_dims) * d + 2 * M * d * d_att
-    per_tok = (
-        2 * d * d_att          # attention query projection
-        + 2 * M * d_att        # scores
-        + 2 * M * d            # context weighted sum
-        + 2 * 4 * d * (3 * d)  # LSTM: 4 gates x (input 2d [word+ctx] + hidden d)
-        + 2 * d * V            # output projection
-    )
-    return float(enc), float(per_tok)
+    — the shared cost model for the RL and XE benches (obs/flops.py)."""
+    return _shared_enc_per_tok(F, d, d, d_att, V, feat_dims, 1)
 
 
 def _analytic_flops_per_clip(
@@ -210,15 +176,15 @@ def _analytic_flops_per_clip(
     forward pass, then per decoded/teacher-forced token the attention
     (query proj, scores, context sum over the M=2F concat memory), the
     input-feed LSTM (in = word d + ctx d), and the d->V output projection.
-    Decode runs the encoder once each for the greedy and sampling programs
-    (sample_decode shares one encode across rollouts) and steps 1+K rows per
-    clip; the update encodes each clip ONCE and tiles the encoded memory
-    over the K teacher-forced rollout copies (scst._tile_enc), with a
-    backward pass (~2x forward). Elementwise / softmax work is ignored
-    (matmul-dominated).
+    Decode is the FUSED one-loop program (PR 4, decoding/fused.py): one
+    encoder pass feeds the greedy lane and the K sampled lanes, stepping
+    1+K rows per clip; the update encodes each clip ONCE and tiles the
+    encoded memory over the K teacher-forced rollout copies
+    (scst._tile_enc), with a backward pass (~2x forward). Elementwise /
+    softmax work is ignored (matmul-dominated).
     """
     enc, per_tok = _enc_and_per_tok_flops(F, d, d_att, V, feat_dims)
-    decode = 2 * enc + (1 + K) * T * per_tok
+    decode = enc + (1 + K) * T * per_tok
     update = 3 * (enc + K * T * per_tok)
     return float(decode + update)
 
@@ -269,9 +235,11 @@ def _program_roofline(
         return w_step + mem_step + 2 * rows * V * logit_bytes
 
     decode = {
-        "flops": B * (2 * enc_flops + (1 + K) * T * per_tok_flops),
-        # greedy program + sampling program, each: encode + T scan steps
-        "bytes": 2 * enc_bytes + T * (step_bytes(B) + step_bytes(K * B)),
+        "flops": B * (enc_flops + (1 + K) * T * per_tok_flops),
+        # the fused one-loop program (PR 4): one encoder pass, T scan steps
+        # over 1+K lanes — per step one weight read + one memory-bank read
+        # shared by every lane (the two-loop reference paid both twice)
+        "bytes": enc_bytes + T * step_bytes((1 + K) * B),
     }
     update = {
         "flops": 3 * B * (enc_flops + K * T * per_tok_flops),

@@ -4,10 +4,11 @@
 
 ``<run_dir>`` is the directory ``train.obs_dir`` (or ``--obs``) pointed a
 run at — it must contain the run's ``events.jsonl``. Prints the phase table
-(per-phase totals, self-time %-of-wall-clock, p50/p95/max) and the
-resilience summary (nan-skips, rollbacks, retries, chaos faults). Pure
-stdlib — no jax import, safe anywhere (scripts/lint.sh runs it as a smoke
-check against the committed fixture run).
+(per-phase totals, self-time %-of-wall-clock, analytic-FLOPs mfu,
+p50/p95/max), the decode early-exit summary (scan depth vs the T budget),
+and the resilience summary (nan-skips, rollbacks, retries, chaos faults).
+Pure stdlib — no jax import, safe anywhere (scripts/lint.sh runs it as a
+smoke check against the committed fixture run).
 """
 
 from __future__ import annotations

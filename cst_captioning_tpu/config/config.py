@@ -63,6 +63,16 @@ class ModelConfig:
     # BENCH_ATTENTION.json — so "xla" is recommended everywhere; the kernel
     # is long-context insurance)
     attention_impl: str = "xla"
+    # decode-step implementation for the greedy/sampling/fused RL decode
+    # loops (README "Decode fast path"): "xla" (the composite the loops'
+    # lane-batched step compiles to, default) or "pallas"
+    # (ops/decode_pallas.py — one fused kernel per step: attention + LSTM
+    # stack + output projection with the decoder weights resident in VMEM
+    # across the row grid). Decode is inference-only (REINFORCE gradients go
+    # through the teacher-forced update path), so the kernel has no VJP;
+    # parity-swept against the XLA step in tests/test_ops_decode_pallas.py,
+    # benchmarked by bench_decode.py (BENCH_DECODE.json)
+    decode_impl: str = "xla"
 
     def __post_init__(self):
         if isinstance(self.modalities, Mapping):
@@ -77,6 +87,19 @@ class ModelConfig:
             raise ValueError(
                 f"unknown attention_impl: {self.attention_impl!r} "
                 "(expected 'xla' or 'pallas')"
+            )
+        if self.decode_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown decode_impl: {self.decode_impl!r} "
+                "(expected 'xla' or 'pallas')"
+            )
+        if self.decode_impl == "pallas" and self.seq_axis:
+            # the kernel's in-VMEM softmax is single-device; a frame-sharded
+            # memory bank needs the collective softmax path
+            raise ValueError(
+                "decode_impl='pallas' cannot run with a frame-sharded "
+                "memory bank (seq_axis set) — the kernel's attention "
+                "softmax is not collective"
             )
 
     @property
@@ -264,6 +287,14 @@ class ExperimentConfig:
             # would silently override the kernel — fail loudly instead
             raise ValueError(
                 "attention_impl='pallas' is not implemented for the "
+                "sequence-parallel ('seq_devices > 1') path; use one or the "
+                "other"
+            )
+        if self.model.decode_impl == "pallas" and self.mesh.seq_devices > 1:
+            # the decode kernel fuses its own (single-device) attention
+            # softmax — it cannot express the collective 'seq' softmax
+            raise ValueError(
+                "decode_impl='pallas' is not implemented for the "
                 "sequence-parallel ('seq_devices > 1') path; use one or the "
                 "other"
             )

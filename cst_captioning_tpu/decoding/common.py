@@ -9,6 +9,70 @@ from cst_captioning_tpu.compat import pcast, vma_of
 from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID
 
 
+def selected_logprob(logits: jnp.ndarray, token: jnp.ndarray) -> jnp.ndarray:
+    """Logprob of ``token`` under softmax(logits) — [..., V], [...] -> [...].
+
+    ``logit - logsumexp(logits)`` on the selected row only: one [.., V]
+    reduction plus a gather, instead of materializing the full ``[.., V]``
+    ``log_softmax`` output just to gather one column from it (one fewer
+    full-vocab pass per decode step). Matches ``log_softmax`` + gather to
+    float association order.
+    """
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    sel = jnp.take_along_axis(logits, token[..., None], axis=-1)[..., 0]
+    return sel - lse
+
+
+def rollout_step_keys(rng: jax.Array, num_rollouts: int, length: int) -> jax.Array:
+    """[T, K] typed key array with ``keys[t, k] == fold_in(fold_in(rng, k), t)``.
+
+    The sampling loops' per-step RNG discipline, precomputed OUTSIDE the
+    scan: the step body gathers row ``t`` (one dynamic slice of K keys)
+    instead of re-folding K keys every iteration — bit-identical streams by
+    construction (same fold chain), asserted in tests/test_decoding.py.
+    Steps past ``length`` (the early-exit loop's overhang, see
+    :func:`scan_until_finished`) clamp to row T-1; their draws are
+    select-frozen out of the outputs, so the clamped reuse is unobservable.
+    """
+    keys = jax.vmap(lambda k: jax.random.fold_in(rng, k))(
+        jnp.arange(num_rollouts)
+    )
+    return jax.vmap(
+        lambda t: jax.vmap(lambda key: jax.random.fold_in(key, t))(keys)
+    )(jnp.arange(length))
+
+
+def lane_decode_step(model, params, carry, token, enc):
+    """One decoder step over a LANE-batched state: [G, B, ...] -> [G, B, V].
+
+    The shared step of every decode loop (greedy runs G=1, K-rollout
+    sampling G=K, the fused RL loop G=1+K — all lanes share the encoder
+    output, closed over unbatched so XLA reads the memory bank once per
+    step). Dispatches on ``model.cfg.decode_impl``: "xla" vmaps
+    ``CaptionModel.decode_step``; "pallas" calls the fused decode-step
+    kernel (ops/decode_pallas.py — attention + LSTM stack + out_proj in one
+    launch, weights resident in VMEM across the row grid). Decode is
+    inference-only, so the kernel needs no VJP.
+    """
+    if getattr(model.cfg, "decode_impl", "xla") == "pallas":
+        from cst_captioning_tpu.ops.decode_pallas import fused_decode_step
+
+        return fused_decode_step(
+            params["params"]["cell"], carry, token,
+            enc.memory, enc.memory_proj, enc.memory_mask,
+            num_layers=model.cfg.num_layers,
+        )
+
+    from cst_captioning_tpu.models.captioner import CaptionModel
+
+    def one_lane(carry_k, token_k):
+        return model.apply(
+            params, carry_k, token_k, enc, method=CaptionModel.decode_step
+        )
+
+    return jax.vmap(one_lane)(carry, token)
+
+
 def pcast_varying(tree, axes: tuple[str, ...]):
     """pcast every leaf to "varying" over ``axes`` it isn't already varying on.
 

@@ -1,0 +1,92 @@
+"""Fused one-loop RL decode: greedy baseline + K rollouts in ONE scan.
+
+The SCST decode program used to run ``greedy_decode`` then ``sample_decode``
+as two *sequential* ``scan_until_finished`` loops inside one jitted program
+(rl/scst.py pre-PR 4) — two encoder passes, two T-step while loops, and per
+step two separate attention/LSTM dispatches over the same memory bank.
+Round-5 bench put that program at 85.1% of sequential RL step time at MFU
+0.010: the loop is latency-bound, so its cost scales with *steps
+dispatched*, not FLOPs.
+
+Here the greedy baseline is folded in as lane 0 of a single (1+K)-lane
+scan: lane 0 takes the argmax of its untempered logits, lanes 1..K sample
+``categorical(fold_in(fold_in(rng, k), t), logits/temperature)`` — exactly
+``sample_decode``'s key stream, so the sampled lanes are bit-identical to
+the two-loop reference by construction (vmap lane results do not depend on
+the lane count), and the greedy lane is bit-identical to ``greedy_decode``
+(which runs the same lane-batched step at G=1). One encoder pass feeds all
+lanes; the loop exits once EVERY lane of every clip has emitted EOS.
+Pinned bit-exact against the two-loop reference in tests/test_decoding.py
+and tests/test_rl.py (sharded ``batch_axes`` variant included).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.config.config import BOS_ID, PAD_ID
+from cst_captioning_tpu.decoding.common import (
+    apply_min_len,
+    forbid_special,
+    lane_decode_step,
+    rollout_step_keys,
+    scan_until_finished,
+    selected_logprob,
+    step_outputs,
+)
+from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
+
+
+def fused_decode(
+    model: CaptionModel,
+    params,
+    feats: dict[str, jnp.ndarray],
+    masks: dict[str, jnp.ndarray],
+    rng: jax.Array,
+    num_rollouts: int = 1,
+    temperature: float = 1.0,
+    max_len: int | None = None,
+    min_len: int = 0,
+    batch_axes: tuple[str, ...] = (),
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """-> (greedy [B,T], greedy_lp [B,T], tokens [K,B,T], logprobs [K,B,T]).
+
+    Lane 0 is the greedy baseline (argmax of untempered logits, no RNG
+    consumed); lanes 1..K are the Monte-Carlo rollouts on ``sample_decode``'s
+    exact key stream. ``logprobs`` are untempered model logprobs of the
+    chosen tokens (``selected_logprob``); PAD/0 after EOS on every lane.
+    """
+    T = max_len or model.cfg.max_len
+    K = num_rollouts
+    enc: EncoderOutput = model.apply(params, feats, masks, method=CaptionModel.encode)
+    B = enc.memory.shape[0]
+    step_keys = rollout_step_keys(rng, K, T)  # [T, K] — lane 0 never draws
+
+    def step(state, t):
+        carry, token, finished = state  # carry leaves [1+K, B, ...]; [1+K, B]
+        carry, logits = lane_decode_step(model, params, carry, token, enc)
+        logits = apply_min_len(forbid_special(logits), t, min_len)  # [1+K,B,V]
+        g_nxt = jnp.argmax(logits[0], axis=-1)
+        s_nxt = jax.vmap(
+            lambda k_, l_: jax.random.categorical(k_, l_ / temperature, axis=-1)
+        )(step_keys[t], logits[1:])
+        nxt = jnp.concatenate([g_nxt[None], s_nxt], axis=0).astype(jnp.int32)
+        lp = selected_logprob(logits, nxt)
+        nxt, lp, finished = step_outputs(nxt, lp, finished)
+        return (carry, nxt, finished), (nxt, lp)
+
+    init = (
+        jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (1 + K,) + x.shape), enc.carry
+        ),
+        jnp.full((1 + K, B), BOS_ID, jnp.int32),
+        jnp.zeros((1 + K, B), bool),
+    )
+    _, (tokens, logprobs) = scan_until_finished(
+        step, init, T, lambda s: s[2], (PAD_ID, 0.0), batch_axes
+    )
+    # ys stack on axis 0: [T, 1+K, B] -> [1+K, B, T]
+    tokens = tokens.transpose(1, 2, 0)
+    logprobs = logprobs.transpose(1, 2, 0)
+    return tokens[0], logprobs[0], tokens[1:], logprobs[1:]

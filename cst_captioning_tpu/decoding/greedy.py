@@ -1,8 +1,13 @@
 """Greedy decoding under ``lax.scan`` (the SCST baseline decode).
 
 Reference behavior: ``model.sample(feats, greedy)`` — argmax token per step,
-stop at EOS (SURVEY.md §3.2). Runs the shared ``decode_step``; one compiled
-program per (batch, max_len) shape.
+stop at EOS (SURVEY.md §3.2). Runs the shared lane-batched decode step as a
+single lane (G=1), so the step numerics are lane-for-lane identical to the
+sampling and fused RL loops (vmap lane results are independent of the lane
+count — what makes the fused loop's greedy row bit-exact against this one,
+pinned in tests/test_decoding.py). One compiled program per (batch,
+max_len) shape; ``model.cfg.decode_impl`` selects the XLA composite step or
+the fused Pallas kernel.
 """
 
 from __future__ import annotations
@@ -14,7 +19,9 @@ from cst_captioning_tpu.config.config import BOS_ID, PAD_ID
 from cst_captioning_tpu.decoding.common import (
     apply_min_len,
     forbid_special,
+    lane_decode_step,
     scan_until_finished,
+    selected_logprob,
     step_outputs,
 )
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
@@ -41,19 +48,21 @@ def greedy_decode(
     B = enc.memory.shape[0]
 
     def step(state, t):
-        carry, token, finished = state
-        carry, logits = model.apply(
-            params, carry, token, enc, method=CaptionModel.decode_step
-        )
+        carry, token, finished = state  # carry leaves [1, B, ...]; [1, B]
+        carry, logits = lane_decode_step(model, params, carry, token, enc)
         logits = apply_min_len(forbid_special(logits), t, min_len)
-        logp = jax.nn.log_softmax(logits, axis=-1)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+        lp = selected_logprob(logits, nxt)
         nxt, lp, finished = step_outputs(nxt, lp, finished)
         return (carry, nxt, finished), (nxt, lp)
 
-    init = (enc.carry, jnp.full((B,), BOS_ID, jnp.int32), jnp.zeros((B,), bool))
+    init = (
+        jax.tree.map(lambda x: x[None], enc.carry),
+        jnp.full((1, B), BOS_ID, jnp.int32),
+        jnp.zeros((1, B), bool),
+    )
     _, (tokens, logprobs) = scan_until_finished(
         step, init, T, lambda s: s[2], (PAD_ID, 0.0), batch_axes
     )
-    return tokens.T, logprobs.T  # ys stack on axis 0 -> [B, T]
+    # ys stack on axis 0: [T, 1, B] -> [B, T]
+    return tokens[:, 0].T, logprobs[:, 0].T

@@ -9,7 +9,9 @@ whose loop exits as soon as every rollout of every clip has emitted EOS.
 
 RNG discipline: rollout k at step t uses ``fold_in(fold_in(key, k), t)``,
 drawn per-rollout over its [B, V] logits block — reproducible regardless of
-batch sharding or rollout count.
+batch sharding or rollout count. The whole [T, K] key array is precomputed
+outside the scan (``rollout_step_keys``); the step body gathers row ``t``
+instead of re-folding K keys per iteration — the same stream bit-for-bit.
 """
 
 from __future__ import annotations
@@ -21,7 +23,10 @@ from cst_captioning_tpu.config.config import BOS_ID, PAD_ID
 from cst_captioning_tpu.decoding.common import (
     apply_min_len,
     forbid_special,
+    lane_decode_step,
+    rollout_step_keys,
     scan_until_finished,
+    selected_logprob,
     step_outputs,
 )
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
@@ -49,28 +54,21 @@ def sample_decode(
     enc: EncoderOutput = model.apply(params, feats, masks, method=CaptionModel.encode)
     B = enc.memory.shape[0]
 
-    # the decode step is vmapped over the rollout axis with the encoder
+    # the decode step is lane-batched over the rollout axis with the encoder
     # output CLOSED OVER (unbatched): XLA reads the memory bank once per
     # step and fuses the additive-attention broadcast across rollouts. (A
     # flat [K*B]-row layout with tiled memory was measured 80% slower at the
     # flagship dims, round 5 — the tile defeats that fusion.)
-    keys = jax.vmap(lambda k: jax.random.fold_in(rng, k))(jnp.arange(K))
-
-    def one_rollout_step(carry_k, token_k):
-        return model.apply(
-            params, carry_k, token_k, enc, method=CaptionModel.decode_step
-        )
+    step_keys = rollout_step_keys(rng, K, T)  # [T, K]
 
     def step(state, t):
         carry, token, finished = state  # carry leaves [K, B, ...]; [K, B]
-        carry, logits = jax.vmap(one_rollout_step)(carry, token)
+        carry, logits = lane_decode_step(model, params, carry, token, enc)
         logits = apply_min_len(forbid_special(logits), t, min_len)  # [K,B,V]
-        step_keys = jax.vmap(lambda k_: jax.random.fold_in(k_, t))(keys)
         nxt = jax.vmap(
             lambda k_, l_: jax.random.categorical(k_, l_ / temperature, axis=-1)
-        )(step_keys, logits).astype(jnp.int32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        lp = jnp.take_along_axis(logp, nxt[..., None], axis=-1)[..., 0]
+        )(step_keys[t], logits).astype(jnp.int32)
+        lp = selected_logprob(logits, nxt)
         nxt, lp, finished = step_outputs(nxt, lp, finished)
         return (carry, nxt, finished), (nxt, lp)
 

@@ -19,7 +19,6 @@ import functools
 
 import flax.linen as nn
 import flax.struct
-import jax
 import jax.numpy as jnp
 
 from cst_captioning_tpu.config.config import BOS_ID, ModelConfig
@@ -55,15 +54,17 @@ def _scan_step_logp(mdl, carry, tokens, memory, memory_proj, memory_mask,
 
     The per-step ``[B, V]`` logits are consumed immediately (logsumexp +
     gather fuse into the step), so the ``[B, T, V]`` stack never reaches
-    HBM — the point of :meth:`CaptionModel.teacher_force_logps`."""
+    HBM — the point of :meth:`CaptionModel.teacher_force_logps`. Shares
+    ``selected_logprob`` with the decode loops: the REINFORCE logprobs and
+    the decode-time logprobs are the same association order by construction.
+    """
+    from cst_captioning_tpu.decoding.common import selected_logprob
+
     token_in, token_tgt = tokens
     carry, logits = mdl.cell(
         carry, token_in, memory, memory_proj, memory_mask, deterministic
     )
-    logits = logits.astype(jnp.float32)
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, token_tgt[:, None], axis=-1)[:, 0]
-    return carry, tgt - lse
+    return carry, selected_logprob(logits.astype(jnp.float32), token_tgt)
 
 
 class CaptionModel(nn.Module):

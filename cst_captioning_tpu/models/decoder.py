@@ -6,6 +6,12 @@ with the previous top-layer hidden state, feed ``[word_emb, context]`` through
 the LSTM stack, project to vocab logits. Written as a single-step module so
 teacher forcing (``nn.scan``), greedy/multinomial sampling and beam search all
 share the exact same parameters and code path.
+
+``ops/decode_pallas.py`` reimplements exactly this step (minus dropout —
+decode is deterministic) as one fused TPU kernel over this module's
+parameter tree, selected by ``ModelConfig.decode_impl``; any change to the
+math here must be mirrored there (the parity sweep in
+tests/test_ops_decode_pallas.py pins the two together).
 """
 
 from __future__ import annotations
@@ -18,6 +24,13 @@ from cst_captioning_tpu.models.attention import AdditiveAttention
 
 # carry: tuple over layers of LSTM (c, h) pairs
 Carry = tuple[tuple[jnp.ndarray, jnp.ndarray], ...]
+
+# flax OptimizedLSTMCell parameter families, in the order its concatenated
+# gate matmul splits them: i (input), f (forget), g (cell), o (output).
+# ops/decode_pallas.py concatenates the per-gate kernels in EXACTLY this
+# order when it rebuilds the cell's gate matmul inside the fused decode-step
+# kernel — keep the two in lockstep.
+LSTM_GATE_ORDER = ("i", "f", "g", "o")
 
 
 class DecoderCell(nn.Module):

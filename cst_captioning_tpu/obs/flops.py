@@ -1,0 +1,125 @@
+"""Analytic matmul-FLOP cost models + chip peak tables (pure stdlib).
+
+One source of truth for the numbers three consumers previously duplicated
+or could not share:
+
+- ``bench.py`` / ``bench_decode.py`` — roofline MFU / bw_util columns;
+- the trainer / SCST loop — per-step ``flops.<phase>`` counters feeding the
+  run report's MFU column (``obs/report.py``);
+- ``cli.obs_report`` — which must aggregate WITHOUT importing jax, hence
+  everything here is plain arithmetic over ints.
+
+Conventions (unchanged from bench.py's original model): FLOPs count matmuls
+only as ``2*m*n*k`` — elementwise/softmax work is ignored (the model is
+matmul-dominated); the backward pass is taken as 2x the forward (3x
+overall). ``E`` below is the encoder output dim (== ``d_embed``: every
+modality is embedded to ``d_embed`` and concatenated on the frame axis, so
+``M = n_modalities * F``).
+"""
+
+from __future__ import annotations
+
+# peak dense bf16 FLOP/s and HBM bandwidth per chip by device kind (public
+# TPU specs); the match is substring-based and callers carry the assumed
+# values in their JSON so they cannot be misread as measured
+PEAK_BF16_FLOPS = (
+    ("v6e", 918e12), ("v6 lite", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+)
+DEFAULT_PEAK = 197e12
+PEAK_HBM_BYTES = (
+    ("v6e", 1640e9), ("v6 lite", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9), ("v5 lite", 819e9), ("v5litepod", 819e9),
+    ("v4", 1228e9),
+)
+DEFAULT_PEAK_HBM = 819e9
+
+
+def peak_flops(device_kind: str) -> float:
+    """Assumed peak dense bf16 FLOP/s for a ``device_kind`` string."""
+    kind = device_kind.lower()
+    for frag, peak in PEAK_BF16_FLOPS:
+        if frag in kind:
+            return peak
+    return DEFAULT_PEAK
+
+
+def peak_hbm(device_kind: str) -> float:
+    """Assumed peak HBM bytes/s for a ``device_kind`` string."""
+    kind = device_kind.lower()
+    for frag, peak in PEAK_HBM_BYTES:
+        if frag in kind:
+            return peak
+    return DEFAULT_PEAK_HBM
+
+
+def enc_and_per_tok_flops(
+    F: int, d_embed: int, d_hidden: int, d_att: int, V: int,
+    feat_dims: tuple[int, ...], num_layers: int = 1,
+) -> tuple[float, float]:
+    """(encoder-pass, per-decoded-token) matmul FLOPs of the caption model.
+
+    Encoder: per-modality frame embeddings + the attention memory-key
+    projection. Per token: additive attention (query proj, scores, context
+    sum over the M-slot concat memory), the input-feed LSTM stack (layer 0
+    input is ``[word_emb, ctx]`` = ``2*d_embed``), and the output
+    projection.
+    """
+    M = len(feat_dims) * F
+    E, H, A = d_embed, d_hidden, d_att
+    enc = 2 * F * sum(feat_dims) * E + 2 * M * E * A
+    lstm = 2 * (E + E) * (4 * H) + 2 * H * (4 * H)        # layer 0
+    lstm += (num_layers - 1) * (2 * H * (4 * H) + 2 * H * (4 * H))
+    per_tok = (
+        2 * H * A          # attention query projection
+        + 2 * M * A        # scores
+        + 2 * M * E        # context weighted sum
+        + lstm
+        + 2 * H * V        # output projection
+    )
+    return float(enc), float(per_tok)
+
+
+def decode_flops_per_clip(
+    K: int, T: int, F: int, d_embed: int, d_hidden: int, d_att: int, V: int,
+    feat_dims: tuple[int, ...], num_layers: int = 1,
+    with_greedy: bool = True, fused: bool = True,
+) -> float:
+    """Matmul FLOPs of one RL decode per clip.
+
+    ``fused=True`` (the one-loop default, PR 4): ONE encoder pass feeds both
+    the greedy lane and the K sampled lanes. ``fused=False`` is the two-loop
+    reference: greedy and sampling each run their own encoder pass.
+    """
+    enc, per_tok = enc_and_per_tok_flops(
+        F, d_embed, d_hidden, d_att, V, feat_dims, num_layers
+    )
+    lanes = (1 if with_greedy else 0) + K
+    enc_passes = 1 if (fused or not with_greedy) else 2
+    return float(enc_passes * enc + lanes * T * per_tok)
+
+
+def update_flops_per_clip(
+    K: int, T: int, F: int, d_embed: int, d_hidden: int, d_att: int, V: int,
+    feat_dims: tuple[int, ...], num_layers: int = 1,
+) -> float:
+    """Matmul FLOPs of one REINFORCE update per clip: one encoder pass, K
+    teacher-forced rollout rows, forward+backward as 3x forward."""
+    enc, per_tok = enc_and_per_tok_flops(
+        F, d_embed, d_hidden, d_att, V, feat_dims, num_layers
+    )
+    return float(3 * (enc + K * T * per_tok))
+
+
+def xe_flops_per_row(
+    T: int, F: int, d_embed: int, d_hidden: int, d_att: int, V: int,
+    feat_dims: tuple[int, ...], num_layers: int = 1,
+) -> float:
+    """Matmul FLOPs of one teacher-forced XE row (forward+backward)."""
+    enc, per_tok = enc_and_per_tok_flops(
+        F, d_embed, d_hidden, d_att, V, feat_dims, num_layers
+    )
+    return float(3 * (enc + T * per_tok))

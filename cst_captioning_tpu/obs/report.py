@@ -19,7 +19,22 @@ separate overlap section, never summed against wall clock.
 
 The resilience summary reads the LAST metrics snapshot in the stream —
 counters are cumulative, so the newest snapshot is the run total even if
-the run died between cadenced snapshots.
+the run died between cadenced snapshots. The same snapshot feeds two more
+sections (PR 4):
+
+- the phase table's **mfu** column: ``flops.<phase>`` counters (analytic
+  matmul FLOPs the trainer/SCST loop accumulate per step, obs/flops.py)
+  over the RUN's wall clock and the chip's assumed peak
+  (``device.peak_flops`` gauge) — each row is that phase's contribution to
+  run MFU, so the rows SUM to the run's overall analytic MFU. Wall clock,
+  not span self-time, because device programs are dispatched async: a
+  span's wall time measures the host's dispatch window, not the device
+  occupancy, and dividing by it would fabricate impossible MFUs.
+- the **decode early-exit** section: the ``rl.decode.depth`` histogram
+  (scan steps the EOS early-exit loop actually ran per batch, observed
+  host-side from the decoded tokens) against the ``rl.decode.budget``
+  gauge (the T step budget) — what ``scan_until_finished`` saves per
+  epoch.
 """
 
 from __future__ import annotations
@@ -56,6 +71,28 @@ def load_events(run_dir: str) -> list[dict]:
             except ValueError:
                 continue  # torn final line of a killed run
     return out
+
+
+def _hist_quantile(snap: dict, q: float) -> float:
+    """Bucket-interpolated quantile over a Histogram SNAPSHOT dict
+    (mirrors obs.metrics.Histogram.quantile, which the report cannot call —
+    it only sees the serialized {buckets, counts, sum, count, max})."""
+    bounds, counts = snap.get("buckets", []), snap.get("counts", [])
+    total, vmax = snap.get("count", 0), snap.get("max", 0.0)
+    if not total:
+        return 0.0
+    rank = q * total
+    seen = 0
+    for i, c in enumerate(counts):
+        if seen + c >= rank and c > 0:
+            if i >= len(bounds):
+                return vmax
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - seen) / c
+            return min(lo + (hi - lo) * frac, vmax if vmax else hi)
+        seen += c
+    return vmax
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
@@ -121,11 +158,16 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
         wall = max(t_last - t_first, 0.0)
 
     order = {name: i for i, name in enumerate(_PHASE_ORDER)}
+    counters = (last_metrics or {}).get("counters", {})
+    gauges = (last_metrics or {}).get("gauges", {})
+    histograms = (last_metrics or {}).get("histograms", {})
+    peak = float(gauges.get("device.peak_flops", 0.0))
 
     def rows(groups: dict[str, dict]) -> list[dict]:
         out = []
         for name, agg in groups.items():
             durs = sorted(agg["durs"])
+            flops = float(counters.get(f"flops.{name}", 0.0))
             out.append({
                 "phase": name,
                 "count": agg["count"],
@@ -133,6 +175,13 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
                 "self_s": agg["self_total"],
                 "pct_wall": (
                     100.0 * agg["self_total"] / wall if wall > 0 else 0.0
+                ),
+                # this phase's contribution to run MFU: analytic FLOPs over
+                # run wall x chip peak (module docstring — span wall would
+                # measure the async dispatch window, not device occupancy)
+                "mfu": (
+                    flops / wall / peak if flops and wall > 0 and peak > 0
+                    else None
                 ),
                 "p50_s": _percentile(durs, 0.50),
                 "p95_s": _percentile(durs, 0.95),
@@ -146,7 +195,22 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
     overlap_rows = rows(overlap)
     covered = sum(p["self_s"] for p in phases)
 
-    counters = (last_metrics or {}).get("counters", {})
+    depth = histograms.get("rl.decode.depth")
+    decode = None
+    if depth and depth.get("count"):
+        budget = float(gauges.get("rl.decode.budget", 0.0))
+        mean = depth["sum"] / depth["count"]
+        decode = {
+            "batches": depth["count"],
+            "depth_mean": mean,
+            "depth_p50": _hist_quantile(depth, 0.50),
+            "depth_p95": _hist_quantile(depth, 0.95),
+            "depth_max": depth["max"],
+            "budget": budget,
+            # share of the T-step budget the early exit skipped
+            "saved_frac": (1.0 - mean / budget) if budget > 0 else 0.0,
+        }
+
     resilience = {
         "nan_skips": counters.get("resilience.nan_skip", 0),
         "divergences": sum(
@@ -173,6 +237,7 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
         "complete": t_end is not None,
         "phases": phases,
         "overlap": overlap_rows,
+        "decode": decode,
         "resilience": resilience,
         "compile": {
             "count": counters.get("jit.compiles", 0),
@@ -205,19 +270,24 @@ def render_report(report: dict[str, Any]) -> str:
                      "window(s) captured")
     lines.append("")
     hdr = (f"{'phase':<16} {'count':>6} {'total_s':>8} {'self_s':>8} "
-           f"{'%wall':>6} {'p50_s':>8} {'p95_s':>8} {'max_s':>8}")
+           f"{'%wall':>6} {'mfu':>7} {'p50_s':>8} {'p95_s':>8} {'max_s':>8}")
     lines.append(hdr)
     lines.append("-" * len(hdr))
+    mfu_total = 0.0
     for p in report["phases"]:
+        mfu = p.get("mfu")
+        mfu_total += mfu or 0.0
+        mfu_col = f"{mfu:7.4f}" if mfu is not None else " " * 7
         lines.append(
             f"{p['phase']:<16} {p['count']:>6} {_fmt_s(p['total_s'])} "
-            f"{_fmt_s(p['self_s'])} {p['pct_wall']:>6.1f} "
+            f"{_fmt_s(p['self_s'])} {p['pct_wall']:>6.1f} {mfu_col} "
             f"{_fmt_s(p['p50_s'])} {_fmt_s(p['p95_s'])} {_fmt_s(p['max_s'])}"
         )
     lines.append("-" * len(hdr))
     lines.append(
         f"{'covered':<16} {'':>6} {'':>8} {_fmt_s(report['covered_s'])} "
         f"{100.0 * report['coverage']:>6.1f}"
+        + (f" {mfu_total:7.4f}" if mfu_total else "")
     )
     if report["overlap"]:
         lines.append("")
@@ -230,6 +300,16 @@ def render_report(report: dict[str, Any]) -> str:
                 f"{_fmt_s(p['p50_s'])} {_fmt_s(p['p95_s'])} "
                 f"{_fmt_s(p['max_s'])}"
             )
+    d = report.get("decode")
+    if d:
+        lines.append("")
+        lines.append(
+            f"decode early-exit: {int(d['batches'])} batch(es), depth "
+            f"p50/p95/max {d['depth_p50']:.1f}/{d['depth_p95']:.1f}/"
+            f"{d['depth_max']:.0f} of budget {d['budget']:.0f} steps "
+            f"(mean {d['depth_mean']:.1f} — early exit skips "
+            f"{100.0 * d['saved_frac']:.1f}% of the scan budget)"
+        )
     r = report["resilience"]
     lines.append("")
     lines.append("resilience:")

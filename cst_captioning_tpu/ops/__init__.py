@@ -2,11 +2,16 @@
 
 XLA's fusions cover this model well (SURVEY.md §2: "the TPU build's native
 layer is XLA itself plus optional Pallas kernels"); this package holds the
-optional kernels where explicit VMEM blocking beats the default — currently
-the long-context additive-attention context (flash-style online softmax over
-the frame axis).
+optional kernels where explicit VMEM blocking beats the default:
+
+- the long-context additive-attention context (flash-style online softmax
+  over the frame axis, ``model.attention_impl="pallas"``);
+- the weight-stationary fused decode step (attention + LSTM stack + output
+  projection in one launch, ``model.decode_impl="pallas"`` — README
+  "Decode fast path").
 """
 
 from cst_captioning_tpu.ops.attention_pallas import fused_additive_attention
+from cst_captioning_tpu.ops.decode_pallas import fused_decode_step
 
-__all__ = ["fused_additive_attention"]
+__all__ = ["fused_additive_attention", "fused_decode_step"]

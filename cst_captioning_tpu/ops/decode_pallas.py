@@ -1,0 +1,375 @@
+"""Pallas TPU kernel: fused weight-stationary caption decode step.
+
+One RL/eval decode step is, per lane ``g`` and batch row ``b`` (the exact
+``DecoderCell.__call__`` math, models/decoder.py, dropout off — decode is
+deterministic):
+
+    q    = h_top @ Wq + bq                               # [B, A]
+    s    = v . tanh(memory_proj + q[:, None, :])         # [B, M]
+    ctx  = softmax_f32(where(mask, s, -1e9)) @ memory    # [B, E]
+    x    = [word_emb(token), ctx]                        # [B, 2E]
+    (c, h)_l = lstm_l(x) for each layer                  # [B, H]
+    logits = h @ Wo + bo                                 # [B, V] f32
+
+The XLA path lowers this to ~a dozen kernels per step, each re-reading its
+operands from HBM; at round-5 dims the whole decode program ran at MFU
+0.010 / bw_util 0.015 — latency-bound on dispatch, not on a resource. This
+kernel runs the entire step as ONE ``pallas_call`` over a
+``(batch-block, lane, vocab-block)`` grid in which every decoder weight has
+a grid-invariant index map — Pallas fetches each weight block into VMEM
+once and keeps it resident across the whole row grid (the weight-stationary
+layout of TPU decode kernels, Ragged Paged Attention arXiv:2604.15464) —
+and the memory bank block is fetched once per batch block and reused by all
+1+K lanes. The output projection is blocked over the vocab axis
+(``block_v``) so the full ``[H, V]`` matrix never has to fit VMEM; the
+post-LSTM hidden is computed at the first vocab block and stashed in
+scratch for the rest.
+
+Boundaries, stated so the kernel can't be over-read:
+
+- the embed gather ``word_emb[token]`` happens OUTSIDE the kernel (one XLA
+  gather per step): keeping the ``[V, E]`` table out of VMEM is what lets
+  the LSTM + attention weights stay resident at the flagship dims, and a
+  [rows, E] gather is already a single optimal HBM op;
+- residency spans one pallas_call, i.e. one time step across all rows and
+  lanes. Cross-step residency (weights pinned across the
+  ``scan_until_finished`` stride) would need token selection inside the
+  kernel; that headroom is recorded in ROADMAP.md;
+- token selection (argmax / ``jax.random.categorical``) stays outside, so
+  the XLA and Pallas impls share one RNG stream and selection semantics.
+
+Decode never takes gradients (the REINFORCE update teacher-forces through
+its own path), so there is no VJP: differentiating the op raises.
+
+Numerics: all compute in f32 regardless of the model dtype (scores, softmax,
+gates); masked-but-real slots score -1e9 (a fully-masked row degrades to the
+uniform softmax over its M real slots, reference semantics) while
+block-alignment padding is EXCLUDED from the softmax entirely. Parity vs
+the XLA step is pinned by the {f32, bf16} x {small, flagship-ish} sweep in
+tests/test_ops_decode_pallas.py.
+
+Off-TPU (CPU tests) the kernel runs in Pallas interpret mode automatically;
+inside a varying-axis-checked shard_map in interpret mode it falls back to
+the jnp composite (same caveat as ops/attention_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cst_captioning_tpu.compat import vma_of
+from cst_captioning_tpu.models.decoder import LSTM_GATE_ORDER
+
+NEG = -1.0e9
+
+
+def _num_layers(cell_params) -> int:
+    n = sum(1 for k in cell_params if k.startswith("lstm"))
+    if n == 0:
+        raise ValueError("cell params carry no lstm<i> layers")
+    return n
+
+
+def _gate_weights(layer_params):
+    """flax OptimizedLSTMCell per-gate Dense params -> (Wi [in, 4H],
+    Wh [H, 4H], b [1, 4H]), concatenated in LSTM_GATE_ORDER — the same
+    order the cell's own concatenated matmul splits on."""
+    wi = jnp.concatenate(
+        [layer_params[f"i{g}"]["kernel"] for g in LSTM_GATE_ORDER], axis=-1
+    )
+    wh = jnp.concatenate(
+        [layer_params[f"h{g}"]["kernel"] for g in LSTM_GATE_ORDER], axis=-1
+    )
+    b = jnp.concatenate(
+        [layer_params[f"h{g}"]["bias"] for g in LSTM_GATE_ORDER], axis=-1
+    )
+    return wi, wh, b[None, :]
+
+
+def _lstm_math(x, c, h, wi, wh, b):
+    """One OptimizedLSTMCell step in f32: gates split i|f|g|o."""
+    gates = (
+        jnp.dot(x, wi, preferred_element_type=jnp.float32)
+        + jnp.dot(h, wh, preferred_element_type=jnp.float32)
+        + b
+    )
+    i_, f_, g_, o_ = jnp.split(gates, 4, axis=-1)
+    c_new = jax.nn.sigmoid(f_) * c + jax.nn.sigmoid(i_) * jnp.tanh(g_)
+    h_new = jax.nn.sigmoid(o_) * jnp.tanh(c_new)
+    return c_new, h_new
+
+
+def _reference(cell_params, carry, token, memory, memory_proj, memory_mask):
+    """The decode step as a plain-jnp composite over the cell's param tree
+    (f32 compute, like the kernel) — the interpret-mode shard_map fallback
+    and the parity oracle's cross-check."""
+    L = _num_layers(cell_params)
+    emb = jnp.asarray(
+        cell_params["word_embed"]["embedding"]
+    )[token].astype(jnp.float32)
+    wq = cell_params["attention"]["query_proj"]["kernel"].astype(jnp.float32)
+    bq = cell_params["attention"]["query_proj"]["bias"].astype(jnp.float32)
+    v = cell_params["attention"]["score"]["kernel"][:, 0].astype(jnp.float32)
+    h_top = carry[-1][1].astype(jnp.float32)
+    q = h_top @ wq + bq
+    t = jnp.tanh(memory_proj.astype(jnp.float32)[None] + q[:, :, None, :])
+    s = jnp.einsum("gbma,a->gbm", t, v)
+    s = jnp.where(memory_mask[None] > 0, s, NEG)
+    w = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("gbm,bme->gbe", w, memory.astype(jnp.float32))
+    x = jnp.concatenate([emb, ctx], axis=-1)
+    new_carry = []
+    for layer in range(L):
+        wi, wh, b = _gate_weights(cell_params[f"lstm{layer}"])
+        c, h = carry[layer]
+        c_new, h_new = _lstm_math(
+            x, c.astype(jnp.float32), h.astype(jnp.float32),
+            wi.astype(jnp.float32), wh.astype(jnp.float32),
+            b.astype(jnp.float32),
+        )
+        new_carry.append((c_new.astype(c.dtype), h_new.astype(h.dtype)))
+        x = h_new
+    wo = cell_params["out_proj"]["kernel"].astype(jnp.float32)
+    bo = cell_params["out_proj"]["bias"].astype(jnp.float32)
+    logits = x @ wo + bo
+    return tuple(new_carry), logits
+
+
+def _kernel(*refs, num_layers: int, m_true: int):
+    """Grid (batch-block i, lane g, vocab-block vb); weights grid-invariant.
+
+    Ref layout (matching _fused_call's in_specs order):
+      emb, [c_0, h_0, .., c_{L-1}, h_{L-1}], memory, proj, mask,
+      wq, bq, v, [wi_0, wh_0, b_0, ..], wo, bo
+      -> outputs: logits, [c_out_0, h_out_0, ..]; scratch: x_stash
+    """
+    L = num_layers
+    it = iter(refs)
+    emb_ref = next(it)
+    carry_refs = [(next(it), next(it)) for _ in range(L)]
+    mem_ref, proj_ref, mask_ref = next(it), next(it), next(it)
+    wq_ref, bq_ref, v_ref = next(it), next(it), next(it)
+    lstm_refs = [(next(it), next(it), next(it)) for _ in range(L)]
+    wo_ref, bo_ref = next(it), next(it)
+    logits_ref = next(it)
+    carry_out_refs = [(next(it), next(it)) for _ in range(L)]
+    x_scr = next(it)
+
+    vb = pl.program_id(2)
+
+    @pl.when(vb == 0)
+    def _():
+        h_top = carry_refs[L - 1][1][0].astype(jnp.float32)   # [Bb, H]
+        q = (
+            jnp.dot(h_top, wq_ref[:].astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+            + bq_ref[:].astype(jnp.float32)
+        )                                                     # [Bb, A]
+        t = jnp.tanh(proj_ref[:].astype(jnp.float32) + q[:, None, :])
+        s = jnp.sum(t * v_ref[0].astype(jnp.float32)[None, None, :], axis=-1)
+        s = jnp.where(mask_ref[:] > 0, s, NEG)                # [Bb, M]
+        # alignment padding (cols >= m_true) leaves the softmax entirely;
+        # merely-masked REAL slots stay in at -1e9 (reference semantics)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < m_true, s, -jnp.inf)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        w = p / jnp.sum(p, axis=-1, keepdims=True)
+        ctx = jnp.sum(
+            w[:, :, None] * mem_ref[:].astype(jnp.float32), axis=1
+        )                                                     # [Bb, E]
+        x = jnp.concatenate(
+            [emb_ref[0].astype(jnp.float32), ctx], axis=-1
+        )
+        for layer in range(L):
+            c_ref, h_ref = carry_refs[layer]
+            wi_ref, wh_ref, b_ref = lstm_refs[layer]
+            c_new, h_new = _lstm_math(
+                x,
+                c_ref[0].astype(jnp.float32),
+                h_ref[0].astype(jnp.float32),
+                wi_ref[:].astype(jnp.float32),
+                wh_ref[:].astype(jnp.float32),
+                b_ref[:].astype(jnp.float32),
+            )
+            c_out, h_out = carry_out_refs[layer]
+            c_out[0] = c_new.astype(c_out.dtype)
+            h_out[0] = h_new.astype(h_out.dtype)
+            x = h_new
+        x_scr[:] = x
+
+    logits_ref[0] = (
+        jnp.dot(x_scr[:], wo_ref[:].astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+        + bo_ref[:].astype(jnp.float32)
+    )
+
+
+def _pad_to(x, axis, mult, value=0.0):
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _fused_call(cell_params, carry, emb, memory, memory_proj, memory_mask,
+                block_b: int, block_v: int, interpret: bool):
+    L = _num_layers(cell_params)
+    G, B, E = emb.shape
+    M = memory.shape[1]
+    Em = memory.shape[2]
+    A = memory_proj.shape[2]
+    H = carry[0][0].shape[-1]
+    wo = cell_params["out_proj"]["kernel"]
+    bo = cell_params["out_proj"]["bias"][None, :]
+    V = wo.shape[-1]
+
+    block_b = min(block_b, B) if B else block_b
+    Bp = -(-B // block_b) * block_b
+    block_v = min(block_v, -(-V // 128) * 128 if V > 128 else V)
+    Vp = -(-V // block_v) * block_v
+    Mp = -(-M // 128) * 128 if not interpret else M
+
+    embp = _pad_to(emb, 1, block_b)
+    carryp = [
+        (_pad_to(c, 1, block_b), _pad_to(h, 1, block_b)) for c, h in carry
+    ]
+    memp = _pad_to(_pad_to(memory, 0, block_b), 1, Mp)
+    projp = _pad_to(_pad_to(memory_proj, 0, block_b), 1, Mp)
+    maskp = _pad_to(_pad_to(memory_mask, 0, block_b), 1, Mp)
+    wop = _pad_to(wo, 1, block_v)
+    bop = _pad_to(bo, 1, block_v)
+    Mp = maskp.shape[1]
+
+    att = cell_params["attention"]
+    wq = att["query_proj"]["kernel"]
+    bq = att["query_proj"]["bias"][None, :]
+    vs = att["score"]["kernel"][:, 0][None, :]
+
+    const = lambda i, g, vb: (0, 0)   # noqa: E731 — grid-invariant (resident)
+    in_specs = [
+        pl.BlockSpec((1, block_b, E), lambda i, g, vb: (g, i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [embp]
+    for c, h in carryp:
+        for arr in (c, h):
+            in_specs.append(
+                pl.BlockSpec((1, block_b, H), lambda i, g, vb: (g, i, 0),
+                             memory_space=pltpu.VMEM)
+            )
+            args.append(arr)
+    in_specs += [
+        pl.BlockSpec((block_b, Mp, Em), lambda i, g, vb: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, Mp, A), lambda i, g, vb: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, Mp), lambda i, g, vb: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((H, A), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, A), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, A), const, memory_space=pltpu.VMEM),
+    ]
+    args += [memp, projp, maskp, wq, bq, vs]
+    for layer in range(L):
+        wi, wh, b = _gate_weights(cell_params[f"lstm{layer}"])
+        in_specs += [
+            pl.BlockSpec(wi.shape, const, memory_space=pltpu.VMEM),
+            pl.BlockSpec(wh.shape, const, memory_space=pltpu.VMEM),
+            pl.BlockSpec(b.shape, const, memory_space=pltpu.VMEM),
+        ]
+        args += [wi, wh, b]
+    in_specs += [
+        pl.BlockSpec((H, block_v), lambda i, g, vb: (0, vb),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_v), lambda i, g, vb: (0, vb),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [wop, bop]
+
+    # inside a varying-axis-checked shard_map the outputs' vma must be
+    # declared (same recipe as ops/attention_pallas.py); 0.4.x has no vma
+    # parameter on ShapeDtypeStruct
+    vma = frozenset()
+    for x in (emb, memory, memory_proj, memory_mask, *jax.tree.leaves(carry)):
+        vma = vma | vma_of(x)
+    sds = (
+        (lambda s, d: jax.ShapeDtypeStruct(s, d, vma=vma)) if vma
+        else jax.ShapeDtypeStruct
+    )
+    out_shape = [sds((G, Bp, Vp), jnp.float32)]
+    out_specs = [
+        pl.BlockSpec((1, block_b, block_v), lambda i, g, vb: (g, i, vb),
+                     memory_space=pltpu.VMEM)
+    ]
+    for c, h in carry:
+        for arr in (c, h):
+            out_shape.append(sds((G, Bp, H), arr.dtype))
+            out_specs.append(
+                pl.BlockSpec((1, block_b, H), lambda i, g, vb: (g, i, 0),
+                             memory_space=pltpu.VMEM)
+            )
+
+    grid = (Bp // block_b, G, Vp // block_v)
+    outs = pl.pallas_call(
+        functools.partial(_kernel, num_layers=L, m_true=M),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((block_b, H), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    logits = outs[0][:, :B, :V]
+    flat = outs[1:]
+    new_carry = tuple(
+        (flat[2 * layer][:, :B], flat[2 * layer + 1][:, :B])
+        for layer in range(L)
+    )
+    return new_carry, logits
+
+
+def fused_decode_step(cell_params, carry, token, memory, memory_proj,
+                      memory_mask, num_layers: int | None = None,
+                      block_b: int = 32, block_v: int = 1024):
+    """Fused decode step -> (new_carry, logits [G, B, V] f32).
+
+    Args: ``cell_params`` — the DecoderCell param subtree
+    (``params["params"]["cell"]``); ``carry`` — tuple over layers of
+    (c, h), leaves [G, B, H]; ``token`` [G, B] int32; ``memory`` [B, M, E] /
+    ``memory_proj`` [B, M, A] / ``memory_mask`` [B, M] shared by all G
+    lanes. Inference-only: no VJP is defined (decode never takes gradients).
+    """
+    if num_layers is not None and num_layers != _num_layers(cell_params):
+        raise ValueError(
+            f"num_layers {num_layers} does not match the "
+            f"{_num_layers(cell_params)} lstm layers in cell_params"
+        )
+    # the embed gather stays an XLA op (module docstring: keeping the [V, E]
+    # table out of VMEM is what buys the other weights residency).
+    # jnp.asarray: params may arrive as host numpy (a device_get'd
+    # checkpoint), whose __getitem__ rejects traced token indices
+    emb = jnp.asarray(cell_params["word_embed"]["embedding"])[token]
+    interpret = jax.default_backend() != "tpu"
+    if interpret and any(
+        vma_of(x)
+        for x in (emb, memory, memory_proj, memory_mask,
+                  *jax.tree.leaves(carry))
+    ):
+        # Pallas interpret mode can't run under a varying-axis-checked
+        # shard_map — fall back to the composite (CPU tests only; compiled
+        # Mosaic on TPU runs the kernel in every context)
+        return _reference(
+            cell_params, carry, token, memory, memory_proj, memory_mask
+        )
+    return _fused_call(
+        cell_params, carry, emb, memory, memory_proj, memory_mask,
+        block_b, block_v, interpret,
+    )

@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.config.config import ModelConfig
-from cst_captioning_tpu.decoding import greedy_decode, sample_decode
+from cst_captioning_tpu.decoding import fused_decode, greedy_decode, sample_decode
 from cst_captioning_tpu.losses import masked_cross_entropy
 from cst_captioning_tpu.models import CaptionModel
 from cst_captioning_tpu.train.steps import _apply
@@ -86,7 +86,7 @@ def make_sp_forward(model: CaptionModel, mesh: Mesh, data_axis: str = "",
 def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
                    temperature: float = 1.0, max_len: int | None = None,
                    seq_axis: str = "seq", data_axis: str = "",
-                   with_greedy: bool = True) -> Callable:
+                   with_greedy: bool = True, fused: bool = True) -> Callable:
     """Jitted SP decode: (params, feats, masks, rng) -> (greedy, samples|None).
 
     The long-video RL/eval decode: frames sharded over ``seq_axis``; the
@@ -94,7 +94,10 @@ def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
     the product layout for ``MeshConfig.seq_devices > 1``). With
     ``num_rollouts=0`` only the greedy decode runs (eval path);
     ``with_greedy=False`` skips the greedy rollout (greedy is None — the
-    scb/none baselines never consume it, see make_rl_decode).
+    scb/none baselines never consume it, see make_rl_decode). When both run,
+    ``fused=True`` (default) folds the greedy baseline in as lane 0 of the
+    rollout scan — one loop, one encoder pass (decoding/fused.py), pinned
+    bit-exact against the two-loop ``fused=False`` reference.
     """
     f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
     b = data_axis if data_axis else None
@@ -111,6 +114,13 @@ def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
         if data_axis:
             # independent sampling streams per batch shard
             rng = jax.random.fold_in(rng, jax.lax.axis_index(data_axis))
+        if with_greedy and num_rollouts and fused:
+            greedy, _, samples, _ = fused_decode(
+                model, params, feats, masks, rng,
+                num_rollouts=num_rollouts, temperature=temperature,
+                max_len=max_len, batch_axes=bx,
+            )
+            return greedy, samples
         greedy = None
         if with_greedy:
             greedy, _ = greedy_decode(

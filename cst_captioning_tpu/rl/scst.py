@@ -3,9 +3,13 @@
 The throughput-critical path (SURVEY.md §3.2, the north-star metric). Design
 vs the reference's per-batch host↔device ping-pong:
 
-1. ``make_rl_decode``   — ONE jitted program produces the greedy baseline
-   decode AND all K multinomial rollouts, sharing the encoder pass (the
-   reference runs two separate ``model.sample`` calls).
+1. ``make_rl_decode``   — ONE jitted program, ONE scan loop: the greedy
+   baseline rides as lane 0 of the (1+K)-lane rollout scan
+   (decoding/fused.py), sharing the encoder pass and every per-step
+   attention/LSTM dispatch with the K multinomial rollouts (the reference
+   runs two separate ``model.sample`` calls; the pre-PR-4 build ran two
+   sequential scan loops in one program — kept behind ``fused=False`` as
+   the bit-exactness reference).
 2. Host: ``RewardComputer`` scores rollouts + greedy against the consensus
    pools (vectorized numpy, precomputed df); advantage = reward − baseline
    (greedy SCST or self-consensus SCB).
@@ -30,9 +34,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from cst_captioning_tpu import obs
 from cst_captioning_tpu.compat import pcast, shard_map
-from cst_captioning_tpu.config.config import RLConfig
-from cst_captioning_tpu.decoding import greedy_decode, sample_decode
-from cst_captioning_tpu.decoding.common import mask_from_tokens
+from cst_captioning_tpu.config.config import PAD_ID, RLConfig
+from cst_captioning_tpu.decoding import fused_decode, greedy_decode, sample_decode
+from cst_captioning_tpu.decoding.common import _exit_stride, mask_from_tokens
+from cst_captioning_tpu.obs import flops as _flops
 from cst_captioning_tpu.losses import reinforce_loss, sequence_log_probs
 from cst_captioning_tpu.models.captioner import CaptionModel
 from cst_captioning_tpu.resilience import chaos
@@ -44,15 +49,31 @@ from cst_captioning_tpu.train.steps import _apply
 
 def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
                    max_len: int | None = None,
-                   with_greedy: bool = True) -> Callable:
+                   with_greedy: bool = True, fused: bool = True) -> Callable:
     """Jitted: (params, feats, masks, rng) -> (greedy [B,T], samples [K,B,T]).
+
+    ``fused=True`` (default): ONE scan produces greedy and samples — the
+    greedy baseline is lane 0 of the (1+K)-lane rollout scan
+    (decoding/fused.py), eliminating the second loop's encoder pass, its
+    per-step fixed overhead, and the duplicate attention/LSTM dispatch.
+    ``fused=False`` is the two-loop reference the fused path is pinned
+    bit-exact against (tests/test_rl.py) and the baseline ``bench_decode.py``
+    measures speedup over.
 
     ``with_greedy=False`` skips the greedy rollout (``greedy`` is None):
     only the 'greedy' baseline consumes it, so the scb/none baselines save
-    one of the K+1 decoded rows per clip plus its host transfer + reward."""
+    one of the K+1 decoded rows per clip plus its host transfer + reward
+    (already one loop — ``fused`` changes nothing there)."""
 
     @jax.jit
     def decode(params, feats, masks, rng):
+        if with_greedy and fused:
+            greedy, _, samples, _ = fused_decode(
+                model, params, feats, masks, rng,
+                num_rollouts=num_rollouts, temperature=temperature,
+                max_len=max_len,
+            )
+            return greedy, samples
         greedy = None
         if with_greedy:
             greedy, _ = greedy_decode(
@@ -71,7 +92,8 @@ def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
                             temperature: float = 1.0,
                             max_len: int | None = None,
                             axis: str = "data",
-                            with_greedy: bool = True) -> Callable:
+                            with_greedy: bool = True,
+                            fused: bool = True) -> Callable:
     """shard_map decode: batch sharded over the mesh, the dominant RL cost
     scales with chips (SURVEY.md §3.2/§7 step 6) instead of running on one.
 
@@ -84,6 +106,13 @@ def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
 
     def device_decode(params, feats, masks, rng):
         local_rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        if with_greedy and fused:
+            greedy, _, samples, _ = fused_decode(
+                model, params, feats, masks, local_rng,
+                num_rollouts=num_rollouts, temperature=temperature,
+                max_len=max_len, batch_axes=(axis,),
+            )
+            return greedy, samples
         greedy = None
         if with_greedy:
             greedy, _ = greedy_decode(
@@ -359,6 +388,26 @@ class SCSTTrainer:
         self.mesh = mesh
         self.retry = retry or RetryPolicy()
         self.on_event = on_event or (lambda event, **fields: None)
+        # analytic per-clip FLOPs (obs/flops.py) for the run report's MFU
+        # column, plus the early-exit depth accounting (budget + stride) —
+        # all host-side constants, nothing here touches a device value
+        mc = model.cfg
+        dims = dict(
+            F=mc.max_frames, d_embed=mc.d_embed, d_hidden=mc.d_hidden,
+            d_att=mc.d_att, V=mc.vocab_size,
+            feat_dims=tuple(d for _, d in mc.modalities),
+            num_layers=mc.num_layers,
+        )
+        self._depth_budget = max_len or mc.max_len
+        self._depth_stride = _exit_stride(self._depth_budget)
+        self._decode_flops_per_clip = _flops.decode_flops_per_clip(
+            K=cfg.num_rollouts, T=self._depth_budget,
+            with_greedy=(cfg.baseline == "greedy"), **dims,
+        )
+        self._update_flops_per_clip = _flops.update_flops_per_clip(
+            K=cfg.num_rollouts, T=self._depth_budget, **dims,
+        )
+        obs.gauge("rl.decode.budget").set(float(self._depth_budget))
         # only the 'greedy' baseline consumes the greedy rollout: scb/none
         # skip its decode, host transfer, and reward scoring entirely (one
         # of the K+1 decoded rows per clip on the flagship config)
@@ -478,17 +527,51 @@ class SCSTTrainer:
                 greedy_np = multihost.to_host_local(
                     greedy, self.mesh, P("data")
                 ) if self.mesh is not None else np.asarray(greedy)
+            self._observe_decode(greedy_np, samples_np)
             advantage, host_metrics = self._advantage(
                 greedy_np, samples_np, video_ids, valid_np
             )
         return (advantage, host_metrics, samples, feats, masks, valid_np)
+
+    # depth buckets sized to caption-length budgets (T <= ~64), not the
+    # default latency buckets
+    _DEPTH_BUCKETS = (2.0, 4.0, 6.0, 8.0, 10.0, 12.0, 16.0, 20.0, 24.0,
+                      28.0, 32.0, 40.0, 48.0, 64.0)
+
+    def _observe_decode(self, greedy_np, samples_np) -> None:
+        """Decode accounting from the already-on-host tokens: the analytic
+        FLOPs counter behind the report's MFU column, and the early-exit
+        depth histogram (scan steps the while loop actually ran vs the T
+        budget — what ``scan_until_finished`` saves per batch). Both are
+        derived from this process's local rows; no device reads."""
+        obs.counter("flops.rl.decode").inc(
+            samples_np.shape[1] * self._decode_flops_per_clip
+        )
+        if not obs.enabled():
+            return
+        # rows finish at their (EOS-inclusive) length; the loop checks the
+        # exit every `stride` steps, so it runs to the next stride multiple
+        # of the longest row, capped at the padded budget
+        lmax = int((samples_np != PAD_ID).sum(axis=-1).max()) if samples_np.size else 0
+        if greedy_np is not None and greedy_np.size:
+            lmax = max(lmax, int((greedy_np != PAD_ID).sum(axis=-1).max()))
+        stride = self._depth_stride
+        padded = -(-self._depth_budget // stride) * stride
+        depth = min(padded, stride * -(-max(lmax, 1) // stride))
+        obs.histogram("rl.decode.depth", self._DEPTH_BUCKETS).observe(depth)
 
     def _apply(self, state, advantage, host_metrics, samples, feats, masks,
                valid_np):
         """Device half: upload the advantage, dispatch the REINFORCE update."""
         from cst_captioning_tpu.train import multihost
 
-        # host time only: the update is dispatched, never waited on here
+        # host time only: the update is dispatched, never waited on here.
+        # FLOPs are counted over THIS process's rows (valid_np is host-local)
+        # so per-process obs streams sum to the global total, matching the
+        # decode counter's to_host_local convention
+        obs.counter("flops.rl.update").inc(
+            len(valid_np) * self._update_flops_per_clip
+        )
         with obs.span("rl.update"):
             adv = jnp.asarray(advantage, jnp.float32)
             valid = jnp.asarray(valid_np)

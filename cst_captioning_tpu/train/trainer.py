@@ -29,6 +29,7 @@ import jax
 import numpy as np
 
 from cst_captioning_tpu import obs
+from cst_captioning_tpu.obs import flops as _flops
 from cst_captioning_tpu.ckpt import CheckpointManager, load_params
 from cst_captioning_tpu.config.config import EvalConfig, ExperimentConfig
 from cst_captioning_tpu.data.batcher import Batcher
@@ -104,6 +105,15 @@ class Trainer:
         self.val_ds = val_ds
         self.model = CaptionModel(cfg.model)
         self.log = EventLogger(log_path)
+        # analytic FLOPs per teacher-forced XE row (obs/flops.py) — feeds
+        # the run report's MFU column via the flops.xe.step counter
+        mc = cfg.model
+        self._xe_flops_per_row = _flops.xe_flops_per_row(
+            T=mc.max_len, F=mc.max_frames, d_embed=mc.d_embed,
+            d_hidden=mc.d_hidden, d_att=mc.d_att, V=mc.vocab_size,
+            feat_dims=tuple(d for _, d in mc.modalities),
+            num_layers=mc.num_layers,
+        )
         if cfg.train.obs:
             obs_dir = cfg.train.obs_dir or os.path.join(
                 cfg.train.ckpt_dir, "obs"
@@ -114,6 +124,12 @@ class Trainer:
             obs.configure(
                 obs_dir, run=cfg.name,
                 snapshot_every=cfg.train.log_every_steps,
+            )
+            # the run report's MFU column divides the flops.<phase> counters
+            # by this assumed chip peak (obs/flops.py table, keyed on the
+            # device kind — same table bench.py carries in its JSON)
+            obs.gauge("device.peak_flops").set(
+                _flops.peak_flops(jax.devices()[0].device_kind)
             )
         # everything below (state init, resume restore, first collate) is
         # run setup: give it a span so the report's phase totals account for
@@ -569,6 +585,9 @@ class Trainer:
                         profiler.tick()
                         meter.tick(cfg.data.batch_size, first=run["first_step"])
                         run["first_step"] = False
+                        obs.counter("flops.xe.step").inc(
+                            cfg.data.batch_size * self._xe_flops_per_row
+                        )
                         chaos.visit("xe.step")
                         if pre.requested:
                             self._preempt_save("xe", step_no, batch_no, sentinel)

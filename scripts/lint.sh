@@ -13,16 +13,22 @@ cd "$(dirname "$0")/.."
 # AST pass only — no JAX backend, no device, sub-second
 python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
-    bench.py bench_attention.py bench_recipe.py
+    bench.py bench_attention.py bench_decode.py bench_recipe.py
 
 # catches syntax errors in files graftlint may not reach (non-.py-suffixed
 # entry points aside, this is the whole tree)
 python -m compileall -q cst_captioning_tpu tests scripts \
-    bench.py bench_attention.py bench_recipe.py
+    bench.py bench_attention.py bench_decode.py bench_recipe.py
 
 # obs_report smoke check: the report CLI must aggregate a known-good run dir
 # without a jax import or backend init (it is part of the operator loop for
 # dead runs — it has to work on a box with nothing but the repo)
 python -m cst_captioning_tpu.cli.obs_report tests/fixtures/obs_run > /dev/null
+
+# decode fast-path smoke: tiny-dims CPU run of all three decode impls
+# (two-loop / fused one-loop / Pallas kernel) with the fused-vs-two-loop
+# bit-exactness gate inside — keeps bench_decode.py and the kernel from
+# rotting without a TPU in CI (README "Decode fast path")
+JAX_PLATFORMS=cpu python bench_decode.py --smoke > /dev/null
 
 echo "lint.sh: OK"

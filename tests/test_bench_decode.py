@@ -1,0 +1,39 @@
+"""bench_decode.py harness smoke (slow-marked: subprocess + jax compiles).
+
+scripts/lint.sh runs the same ``--smoke`` invocation as a pre-commit gate;
+this test keeps the harness covered from pytest too (``-m slow``) so the
+bench cannot rot into tier-1-green-but-unrunnable. The smoke run itself
+asserts the fused one-loop decode is bit-exact vs the two-loop reference
+(it exits nonzero otherwise), so rc==0 carries real signal.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_decode_smoke_runs_and_reports():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_decode.py"), "--smoke"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    json_lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert json_lines, proc.stdout[-2000:]
+    out = json.loads(json_lines[-1])
+    assert out["metric"] == "rl_decode_seconds_per_step"
+    assert set(out["impls"]) == {"two_loop_xla", "fused_xla", "fused_pallas"}
+    for r in out["impls"].values():
+        assert r["seconds_per_step"] > 0
+        assert r["flops"] > 0 and r["bytes"] > 0
+    assert out["parity"]["fused_xla_greedy_bit_exact"] is True
+    assert out["parity"]["fused_xla_samples_bit_exact"] is True
+    # smoke must not clobber the committed TPU BENCH_DECODE.json
+    assert "BENCH_DECODE.json" not in proc.stderr

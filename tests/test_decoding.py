@@ -1,4 +1,4 @@
-"""Decoding tests: greedy, sampling, beam — incl. beam=1≡greedy and an oracle."""
+"""Decoding tests: greedy, sampling, fused one-loop, beam — incl. oracles."""
 
 import itertools
 
@@ -8,8 +8,17 @@ import numpy as np
 import pytest
 
 from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID, ModelConfig
-from cst_captioning_tpu.decoding import beam_search, greedy_decode, sample_decode
-from cst_captioning_tpu.decoding.common import forbid_special
+from cst_captioning_tpu.decoding import (
+    beam_search,
+    fused_decode,
+    greedy_decode,
+    sample_decode,
+)
+from cst_captioning_tpu.decoding.common import (
+    forbid_special,
+    rollout_step_keys,
+    selected_logprob,
+)
 from cst_captioning_tpu.models import CaptionModel
 from cst_captioning_tpu.models.captioner import CaptionModel as CM
 
@@ -179,6 +188,113 @@ def test_beam_return_all_sorted(setup):
     assert tokens.shape == (B, 4, T) and scores.shape == (B, 4)
     s = np.asarray(scores)
     assert np.all(np.diff(s, axis=1) <= 1e-6)  # descending
+
+
+def test_selected_logprob_matches_log_softmax():
+    """The one-pass selected-row logprob (logit - logsumexp) equals the
+    full log_softmax + gather it replaced, across shapes and dtypes."""
+    rng = np.random.default_rng(7)
+    for shape in [(4, 11), (3, 4, 11), (2, 3, 4, 7)]:
+        logits = jnp.asarray(rng.normal(size=shape) * 5, jnp.float32)
+        token = jnp.asarray(rng.integers(0, shape[-1], size=shape[:-1]), jnp.int32)
+        want = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), token[..., None], axis=-1
+        )[..., 0]
+        got = selected_logprob(logits, token)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+        )
+
+
+def test_rollout_step_keys_is_the_fold_in_chain():
+    """The precomputed [T, K] key array is EXACTLY fold_in(fold_in(rng, k),
+    t) — the per-step re-fold it replaced, bit-for-bit (satellite of the
+    decode fast path: same sampling streams by construction)."""
+    rng = jax.random.key(123)
+    K, T = 4, 7
+    keys = rollout_step_keys(rng, K, T)
+    assert keys.shape == (T, K)
+    got = jax.random.key_data(keys)
+    for t in range(T):
+        for k in range(K):
+            want = jax.random.key_data(
+                jax.random.fold_in(jax.random.fold_in(rng, k), t)
+            )
+            np.testing.assert_array_equal(np.asarray(got[t, k]), np.asarray(want))
+
+
+def test_sample_matches_manual_per_step_folding(setup):
+    """sample_decode (precomputed key array) decodes bit-identical tokens to
+    a manual loop that re-folds the K keys inside every step body."""
+    model, params, feats, masks = setup
+    K = 3
+    rng = jax.random.key(5)
+    tokens, _ = sample_decode(model, params, feats, masks, rng, num_rollouts=K)
+
+    enc = model.apply(params, feats, masks, method=CM.encode)
+    keys = jax.vmap(lambda k: jax.random.fold_in(rng, k))(jnp.arange(K))
+    carry = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (K,) + x.shape), enc.carry)
+    tok = jnp.full((K, B), BOS_ID, jnp.int32)
+    finished = np.zeros((K, B), bool)
+    manual = []
+    for t in range(T):
+        carry, logits = jax.vmap(
+            lambda c, t_: model.apply(params, c, t_, enc, method=CM.decode_step)
+        )(carry, tok)
+        logits = forbid_special(logits)
+        step_keys = jax.vmap(lambda k_: jax.random.fold_in(k_, t))(keys)
+        nxt = np.asarray(jax.vmap(
+            lambda k_, l_: jax.random.categorical(k_, l_, axis=-1)
+        )(step_keys, logits)).astype(np.int32)
+        nxt[finished] = PAD_ID
+        finished |= nxt == EOS_ID
+        manual.append(nxt)
+        tok = jnp.asarray(nxt)
+    np.testing.assert_array_equal(np.asarray(tokens), np.stack(manual, -1))
+
+
+def test_fused_decode_matches_two_loop_bitexact(setup):
+    """The fused one-loop decode is BIT-EXACT against the two-loop reference
+    under a fixed rng: greedy tokens/logprobs (lane 0 vs greedy_decode) and
+    sampled tokens/logprobs (lanes 1..K vs sample_decode)."""
+    model, params, feats, masks = setup
+    K = 3
+    rng = jax.random.key(42)
+    tg, lg = greedy_decode(model, params, feats, masks)
+    ts, ls = sample_decode(model, params, feats, masks, rng, num_rollouts=K)
+    fg, flg, fs, fls = fused_decode(
+        model, params, feats, masks, rng, num_rollouts=K
+    )
+    np.testing.assert_array_equal(np.asarray(fg), np.asarray(tg))
+    np.testing.assert_array_equal(np.asarray(flg), np.asarray(lg))
+    np.testing.assert_array_equal(np.asarray(fs), np.asarray(ts))
+    np.testing.assert_array_equal(np.asarray(fls), np.asarray(ls))
+    # and under jit, exactly as make_rl_decode dispatches it
+    fg2, _, fs2, _ = jax.jit(
+        lambda p, f, m, r: fused_decode(model, p, f, m, r, num_rollouts=K)
+    )(params, feats, masks, rng)
+    np.testing.assert_array_equal(np.asarray(fg2), np.asarray(tg))
+    np.testing.assert_array_equal(np.asarray(fs2), np.asarray(ts))
+
+
+def test_fused_decode_temperature_and_padding(setup):
+    """Temperature reaches the sampled lanes only (greedy lane untempered),
+    and every lane honors PAD-after-EOS / zero-logprob padding."""
+    model, params, feats, masks = setup
+    rng = jax.random.key(3)
+    ts, _ = sample_decode(
+        model, params, feats, masks, rng, num_rollouts=2, temperature=0.5
+    )
+    fg, flg, fs, fls = fused_decode(
+        model, params, feats, masks, rng, num_rollouts=2, temperature=0.5
+    )
+    tg, _ = greedy_decode(model, params, feats, masks)
+    np.testing.assert_array_equal(np.asarray(fs), np.asarray(ts))
+    np.testing.assert_array_equal(np.asarray(fg), np.asarray(tg))
+    _check_pad_after_eos(fg)
+    _check_pad_after_eos(fs)
+    assert np.all(np.asarray(fls)[np.asarray(fs) == PAD_ID] == 0.0)
+    assert np.all(np.asarray(flg)[np.asarray(fg) == PAD_ID] == 0.0)
 
 
 def test_min_len_suppresses_early_eos(setup):

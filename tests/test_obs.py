@@ -71,9 +71,10 @@ def test_span_nesting_ordering_and_self_time(tmp_path):
     assert inner["tag"] == "a"
     assert outer["depth"] == 0 and "parent" not in outer
     assert outer["dur"] >= inner["dur"] >= 0.03
-    # self time excludes the child exactly
+    # self time excludes the child exactly (three values each rounded to 1e-6
+    # independently, so the identity holds to 1.5e-6 in the worst case)
     assert outer["self_dur"] == pytest.approx(
-        outer["dur"] - inner["dur"], abs=1e-6
+        outer["dur"] - inner["dur"], abs=2e-6
     )
     assert inner["self_dur"] == pytest.approx(inner["dur"], abs=1e-6)
 
@@ -309,6 +310,109 @@ def test_report_aggregates_committed_fixture():
     text = render_report(rep)
     assert "xe.step" in text and "chaos faults injected: 3" in text
     assert "nan=2" in text and "rollbacks: 1" in text
+
+
+def test_report_mfu_column_and_decode_section():
+    """The phase table's mfu column (flops.<phase> counters over run wall x
+    device.peak_flops, PR 4) and the decode early-exit section (depth
+    histogram vs budget) — from a synthetic event stream."""
+    span = lambda ts, name, dur: {  # noqa: E731
+        "ts": ts, "event": "span", "name": name, "dur": dur,
+        "self_dur": dur, "depth": 0, "thread": "main",
+    }
+    events = [
+        {"ts": 0.0, "event": "run_start", "run": "mfu", "thread": "main"},
+        span(1.0, "rl.decode", 4.0),
+        span(6.0, "rl.update", 2.0),
+        span(8.0, "xe.step", 1.0),
+        {
+            "ts": 9.0, "event": "metrics",
+            "counters": {
+                "flops.rl.decode": 4e12,   # / 10s wall / 1e12 peak = 0.4
+                "flops.rl.update": 1e12,
+                "flops.xe.step": 5e11,
+            },
+            "gauges": {"device.peak_flops": 1e12,
+                       "rl.decode.budget": 30.0},
+            "histograms": {
+                "rl.decode.depth": {
+                    "buckets": [10.0, 20.0, 30.0],
+                    # two batches exited at depth 15, one ran the budget
+                    "counts": [0, 2, 1, 0],
+                    "sum": 60.0, "count": 3, "max": 30.0,
+                },
+            },
+        },
+        {"ts": 10.0, "event": "run_end", "run": "mfu"},
+    ]
+    rep = build_report(events)
+    by_name = {p["phase"]: p for p in rep["phases"]}
+    assert by_name["rl.decode"]["mfu"] == pytest.approx(0.4)
+    assert by_name["rl.update"]["mfu"] == pytest.approx(0.1)
+    assert by_name["xe.step"]["mfu"] == pytest.approx(0.05)
+    d = rep["decode"]
+    assert d["batches"] == 3 and d["budget"] == 30.0
+    assert d["depth_mean"] == pytest.approx(20.0)
+    assert d["saved_frac"] == pytest.approx(1.0 - 20.0 / 30.0)
+    assert d["depth_max"] == 30.0
+    text = render_report(rep)
+    assert "mfu" in text and "0.4000" in text
+    assert "decode early-exit" in text and "33.3% of the scan budget" in text
+
+
+def test_report_mfu_blank_without_counters():
+    """Rows without a flops counter (or with no peak gauge) get mfu=None and
+    render blank — the fixture run predates the counters."""
+    rep = report_run(FIXTURE_RUN)
+    assert all(p["mfu"] is None for p in rep["phases"])
+    assert rep["decode"] is None
+    render_report(rep)  # renders without error
+
+
+def test_scst_records_flops_and_depth():
+    """An SCST step feeds the flops.rl.decode / flops.rl.update counters and
+    (with a recorder installed) the rl.decode.depth histogram the report's
+    MFU column and decode section read."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from cst_captioning_tpu.config.config import (
+        ModelConfig, RLConfig, TrainConfig,
+    )
+    from cst_captioning_tpu.models import CaptionModel
+    from cst_captioning_tpu.rl import SCSTTrainer
+    from cst_captioning_tpu.train import create_train_state, make_optimizer
+
+    obs.REGISTRY.reset()
+
+    cfg = ModelConfig(
+        vocab_size=20, modalities=(("resnet", 6),), d_embed=8, d_hidden=8,
+        d_att=4, encoder="meanpool", dropout=0.0, max_len=5, max_frames=3,
+        dtype="float32",
+    )
+    model = CaptionModel(cfg)
+    rng = _np.random.default_rng(0)
+    feats = {"resnet": jnp.asarray(rng.normal(size=(4, 3, 6)), jnp.float32)}
+    masks = {"resnet": jnp.ones((4, 3), jnp.float32)}
+    labels = jnp.asarray(rng.integers(4, 20, size=(4, 5)), jnp.int32)
+    tx = make_optimizer(TrainConfig(lr=1e-3, grad_clip=5.0), 10)
+    state = create_train_state(model, tx, (feats, masks, labels), seed=1)
+
+    reward = lambda vids, rows: _np.ones(len(rows), _np.float32)  # noqa: E731
+    scst = SCSTTrainer(
+        model, reward, RLConfig(enabled=True, num_rollouts=2, baseline="greedy")
+    )
+    state, _ = scst.train_step(
+        state, feats, masks, ["v0", "v1", "v2", "v3"], jax.random.key(0)
+    )
+    snap = obs.snapshot()
+    assert snap["counters"]["flops.rl.decode"] > 0
+    assert snap["counters"]["flops.rl.update"] > 0
+    assert snap["gauges"]["rl.decode.budget"] == 5.0
+    # the depth histogram only records when a recorder is installed
+    assert "rl.decode.depth" not in snap["histograms"]
+    obs.REGISTRY.reset()
 
 
 def test_report_handles_torn_stream_and_missing_end(tmp_path):

@@ -351,6 +351,47 @@ def test_parallel_rl_decode_greedy_matches_single(model_setup):
             assert (row[eos[0] + 1 :] == PAD_ID).all()
 
 
+def test_rl_decode_fused_matches_two_loop(model_setup):
+    """make_rl_decode's fused one-loop default is bit-exact vs the two-loop
+    reference (the PR-4 acceptance pin): greedy AND samples, fixed rng."""
+    from cst_captioning_tpu.rl import make_rl_decode
+
+    model, state, feats, masks = model_setup
+    K, T = 3, 5
+    rng = jax.random.key(17)
+    g_two, s_two = make_rl_decode(model, K, max_len=T, fused=False)(
+        state.params, feats, masks, rng
+    )
+    g_one, s_one = make_rl_decode(model, K, max_len=T, fused=True)(
+        state.params, feats, masks, rng
+    )
+    np.testing.assert_array_equal(np.asarray(g_one), np.asarray(g_two))
+    np.testing.assert_array_equal(np.asarray(s_one), np.asarray(s_two))
+
+
+def test_parallel_rl_decode_fused_matches_two_loop(model_setup):
+    """The sharded (batch_axes) fused decode is bit-exact vs the sharded
+    two-loop reference — same mesh, same rng, same shard-folded streams."""
+    from cst_captioning_tpu.rl import make_parallel_rl_decode
+
+    model, state, feats, masks = model_setup
+    mesh = make_mesh()
+    K, T = 3, 5
+    rng = jax.random.key(19)
+    state_r = replicate(mesh, state)
+    f_s, m_s = shard_batch(mesh, (feats, masks))
+    g_two, s_two = make_parallel_rl_decode(model, mesh, K, max_len=T,
+                                           fused=False)(
+        state_r.params, f_s, m_s, rng
+    )
+    g_one, s_one = make_parallel_rl_decode(model, mesh, K, max_len=T,
+                                           fused=True)(
+        state_r.params, f_s, m_s, rng
+    )
+    np.testing.assert_array_equal(np.asarray(g_one), np.asarray(g_two))
+    np.testing.assert_array_equal(np.asarray(s_one), np.asarray(s_two))
+
+
 def test_train_epoch_pipelined_matches_sequential_at_lr0(model_setup):
     """With lr=0 the one-step-stale pipeline is exactly the sequential loop."""
     model, _, feats, masks = model_setup
