@@ -73,6 +73,21 @@ class ModelConfig:
     # parity-swept against the XLA step in tests/test_ops_decode_pallas.py,
     # benchmarked by bench_decode.py (BENCH_DECODE.json)
     decode_impl: str = "xla"
+    # fused RL decode stride: steps per driving-loop iteration (and per
+    # pallas_call when decode_impl="pallas" — the multi-step kernel keeps
+    # decoder weights VMEM-resident across the whole stride). 1 = the
+    # per-step loop (the PR-4 behavior, kept as the exactness baseline).
+    # Token/logprob-exact for every S by construction (pinned in
+    # tests/test_decoding.py); larger strides coarsen the EOS early-exit
+    # granularity, so S should stay well under the typical caption length
+    decode_stride: int = 8
+    # finished-lane compaction between strides: gather batch columns that
+    # still have an unfinished lane into a dense prefix so the stride kernel
+    # skips whole blocks of finished rows (XLA steps full width — the
+    # compute win is the kernel's; the permutation round-trip is
+    # token-exact either way). No-op at decode_stride=1 — compaction only
+    # pays between strides. Off = step every row until the global exit
+    decode_compact: bool = True
 
     def __post_init__(self):
         if isinstance(self.modalities, Mapping):
@@ -92,6 +107,10 @@ class ModelConfig:
             raise ValueError(
                 f"unknown decode_impl: {self.decode_impl!r} "
                 "(expected 'xla' or 'pallas')"
+            )
+        if self.decode_stride < 1:
+            raise ValueError(
+                f"decode_stride {self.decode_stride} must be >= 1"
             )
         if self.decode_impl == "pallas" and self.seq_axis:
             # the kernel's in-VMEM softmax is single-device; a frame-sharded

@@ -42,6 +42,25 @@ def rollout_step_keys(rng: jax.Array, num_rollouts: int, length: int) -> jax.Arr
     )(jnp.arange(length))
 
 
+def gumbel_step_noise(step_keys_t: jax.Array, shape: tuple[int, ...],
+                      dtype) -> jax.Array:
+    """[K] keys -> [K, *shape] Gumbel noise — ``jax.random.categorical``'s
+    internals, reified.
+
+    ``categorical(key, logits)`` is by definition
+    ``argmax(logits + gumbel(key, logits.shape, logits.dtype))`` (the Gumbel
+    -max trick; jax implements it literally), and IEEE addition is
+    commutative, so selecting via this precomputed noise is BIT-IDENTICAL
+    to the categorical call it replaces (pinned in tests/test_decoding.py).
+    Reifying the noise is what lets (a) the compacted decode draw in
+    ORIGINAL batch order and gather rows through the compaction permutation
+    — drawing after the gather would pair rows with different streams — and
+    (b) the Pallas stride kernel select tokens in-kernel on the exact same
+    RNG streams (the noise is data; the argmax moves inside).
+    """
+    return jax.vmap(lambda k: jax.random.gumbel(k, shape, dtype))(step_keys_t)
+
+
 def lane_decode_step(model, params, carry, token, enc):
     """One decoder step over a LANE-batched state: [G, B, ...] -> [G, B, V].
 

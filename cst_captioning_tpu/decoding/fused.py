@@ -11,13 +11,36 @@ dispatched*, not FLOPs.
 Here the greedy baseline is folded in as lane 0 of a single (1+K)-lane
 scan: lane 0 takes the argmax of its untempered logits, lanes 1..K sample
 ``categorical(fold_in(fold_in(rng, k), t), logits/temperature)`` — exactly
-``sample_decode``'s key stream, so the sampled lanes are bit-identical to
-the two-loop reference by construction (vmap lane results do not depend on
-the lane count), and the greedy lane is bit-identical to ``greedy_decode``
-(which runs the same lane-batched step at G=1). One encoder pass feeds all
-lanes; the loop exits once EVERY lane of every clip has emitted EOS.
-Pinned bit-exact against the two-loop reference in tests/test_decoding.py
-and tests/test_rl.py (sharded ``batch_axes`` variant included).
+``sample_decode``'s key stream, spelled in its bit-identical Gumbel-max
+form (``gumbel_step_noise``) so the same streams drive every path below.
+One encoder pass feeds all lanes; the loop exits once EVERY lane of every
+clip has emitted EOS. Pinned bit-exact against the two-loop reference in
+tests/test_decoding.py and tests/test_rl.py.
+
+On top of the one-loop structure sit the two decode-endgame levers
+(``ModelConfig.decode_stride`` / ``decode_compact``):
+
+- **stride**: the driving while loop advances ``S`` time steps per
+  iteration instead of one. On the XLA path that is an inner ``lax.scan``
+  chunk (the early-exit check amortizes over S steps); with
+  ``decode_impl="pallas"`` each chunk is ONE launch of the multi-step
+  stride kernel (ops/decode_pallas.py: token selection + next-token embed
+  lookup in-kernel, decoder weights VMEM-resident across the whole
+  stride).
+- **compaction**: between strides, batch columns whose every lane has
+  finished are permuted out of a dense still-active prefix
+  (``jnp.argsort`` stable: active columns keep their order), the stride
+  steps the permuted state, and outputs scatter back through the inverse
+  permutation. Per-row math is position-independent, so the round trip is
+  token- and logprob-exact (pinned in tests/test_decoding.py); the
+  compute win is the stride kernel's, which skips whole blocks past the
+  ``n_active`` prefix. The while loop's all-finished exit replaces the
+  fixed budget either way.
+
+Every (stride, compact) combination is token- and logprob-exact vs the
+stride-1 uncompacted loop under a fixed rng — selection noise is always
+drawn in ORIGINAL batch order and gathered through the compaction
+permutation, so a row's RNG stream follows it through the shuffle.
 """
 
 from __future__ import annotations
@@ -25,17 +48,181 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from cst_captioning_tpu.config.config import BOS_ID, PAD_ID
+from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID
 from cst_captioning_tpu.decoding.common import (
     apply_min_len,
     forbid_special,
+    gumbel_step_noise,
     lane_decode_step,
+    pcast_varying,
     rollout_step_keys,
     scan_until_finished,
     selected_logprob,
     step_outputs,
 )
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
+
+
+def _sel_step(model, params, enc_c, step_keys, B, V, temperature, min_len,
+              perm):
+    """The (1+K)-lane decode step with fused token selection.
+
+    ``perm`` (compaction permutation, or None) maps the state's column
+    order back to original batch order: Gumbel noise is drawn for ORIGINAL
+    columns and gathered through it, so a clip's sampling stream is
+    independent of where compaction moved it.
+    """
+
+    def step(state, t):
+        carry, token, finished = state  # carry leaves [1+K, B, ...]; [1+K, B]
+        carry, logits = lane_decode_step(model, params, carry, token, enc_c)
+        logits = apply_min_len(forbid_special(logits), t, min_len)  # [1+K,B,V]
+        g_nxt = jnp.argmax(logits[0], axis=-1)
+        tl = logits[1:] / temperature
+        noise = gumbel_step_noise(step_keys[t], (B, V), tl.dtype)
+        if perm is not None:
+            noise = noise[:, perm, :]
+        s_nxt = jnp.argmax(tl + noise, axis=-1)
+        nxt = jnp.concatenate([g_nxt[None], s_nxt], axis=0).astype(jnp.int32)
+        lp = selected_logprob(logits, nxt)
+        nxt, lp, finished = step_outputs(nxt, lp, finished)
+        return (carry, nxt, finished), (nxt, lp)
+
+    return step
+
+
+def _kernel_stride(model, params, state_c, enc_c, noise, t, S, n_active,
+                   temperature, min_len):
+    """One stride via the multi-step Pallas kernel -> (state', toks, lps)."""
+    from cst_captioning_tpu.ops.decode_pallas import fused_decode_stride
+
+    carry, token, finished = state_c
+    new_carry, toks, lps = fused_decode_stride(
+        params["params"]["cell"], carry, token, finished,
+        enc_c.memory, enc_c.memory_proj, enc_c.memory_mask,
+        noise, t, n_active, steps=S, temperature=temperature,
+        min_len=min_len, num_layers=model.cfg.num_layers,
+    )
+    # the kernel emits the frozen-token stream; the carried token is the
+    # last emission and finished accumulates any EOS in the chunk — the
+    # exact state the XLA step chain would carry
+    finished = finished | jnp.any(toks == EOS_ID, axis=0)
+    return (new_carry, toks[-1], finished), toks, lps
+
+
+def _stride_decode(model, params, enc: EncoderOutput, step_keys, B, T, S, K,
+                   temperature, min_len, compact, batch_axes):
+    """The strided driving loop (module docstring): while over S-step
+    chunks, optional finished-column compaction between chunks, all-
+    finished early exit. Returns (tokens [P,1+K,B], logprobs [P,1+K,B])
+    already sliced to the T budget."""
+    G = 1 + K
+    V = model.cfg.vocab_size
+    padded = -(-T // S) * S
+    use_kernel = getattr(model.cfg, "decode_impl", "xla") == "pallas"
+
+    init = (
+        jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (G,) + x.shape), enc.carry
+        ),
+        jnp.full((G, B), BOS_ID, jnp.int32),
+        jnp.zeros((G, B), bool),
+    )
+    bufs = (
+        jnp.full((padded, G, B), PAD_ID, jnp.int32),
+        jnp.zeros((padded, G, B), jnp.float32),
+    )
+    init = pcast_varying(init, batch_axes)
+    bufs = pcast_varying(bufs, batch_axes)
+
+    def count_unfinished(finished):
+        n = jnp.sum(jnp.logical_not(finished).astype(jnp.int32))
+        for ax in batch_axes:
+            n = jax.lax.psum(n, ax)
+        return n
+
+    def cond(loop):
+        t, _, _, unfinished = loop
+        return (t < T) & (unfinished > 0)
+
+    def body(loop):
+        t, state, (tok_buf, lp_buf), _ = loop
+        carry, token, finished = state
+        if compact:
+            # stable sort keeps active columns in original relative order,
+            # so the prefix is a gather, not a shuffle
+            col_done = jnp.all(finished, axis=0)                    # [B]
+            perm = jnp.argsort(col_done, stable=True)
+            inv = jnp.argsort(perm, stable=True)
+            n_active = B - jnp.sum(col_done.astype(jnp.int32))
+            carry = jax.tree.map(lambda x: jnp.take(x, perm, axis=1), carry)
+            token = jnp.take(token, perm, axis=1)
+            finished = jnp.take(finished, perm, axis=1)
+            enc_c = enc.take_batch(perm)
+            # materialize the gathered operands: without the barrier XLA
+            # fuses the gather into the step's consumers, changing the
+            # generated code and drifting logits by ULPs vs the uncompacted
+            # loop — with it, the step body sees plain arrays and compiles
+            # to the exact same program, which is what makes compaction
+            # bit-exact rather than merely close (a gather is a copy
+            # anyway, so the barrier costs nothing extra)
+            carry, token, finished, enc_c = jax.lax.optimization_barrier(
+                (carry, token, finished, enc_c)
+            )
+        else:
+            perm = None
+            n_active = jnp.int32(B)
+            enc_c = enc
+        state_c = (carry, token, finished)
+
+        if use_kernel:
+            # the kernel's whole-stride noise, drawn in original column
+            # order from the exact rollout_step_keys streams (overhang rows
+            # past T clamp to row T-1; their emissions never leave the
+            # sliced-off buffer tail)
+            keys_chunk = step_keys[t + jnp.arange(S)]               # [S, K]
+            noise = jax.vmap(
+                lambda ks: gumbel_step_noise(ks, (B, V), jnp.float32)
+            )(keys_chunk)
+            if compact:
+                noise = noise[:, :, perm, :]
+            state_c, tok_chunk, lp_chunk = _kernel_stride(
+                model, params, state_c, enc_c, noise, t, S, n_active,
+                temperature, min_len,
+            )
+        else:
+            step = _sel_step(
+                model, params, enc_c, step_keys, B, V, temperature, min_len,
+                perm,
+            )
+            state_c, (tok_chunk, lp_chunk) = jax.lax.scan(
+                step, state_c, t + jnp.arange(S)
+            )
+
+        carry, token, finished = state_c
+        if compact:
+            carry = jax.tree.map(lambda x: jnp.take(x, inv, axis=1), carry)
+            token = jnp.take(token, inv, axis=1)
+            finished = jnp.take(finished, inv, axis=1)
+            tok_chunk = jnp.take(tok_chunk, inv, axis=2)
+            lp_chunk = jnp.take(lp_chunk, inv, axis=2)
+        tok_buf = jax.lax.dynamic_update_slice_in_dim(tok_buf, tok_chunk, t, 0)
+        lp_buf = jax.lax.dynamic_update_slice_in_dim(lp_buf, lp_chunk, t, 0)
+        return (
+            t + S,
+            (carry, token, finished),
+            (tok_buf, lp_buf),
+            count_unfinished(finished),
+        )
+
+    # overhang steps past T (S not dividing T, final chunk only) need no
+    # state freeze: finished is monotonic, the loop cond exits on t >= T
+    # regardless, and the final state is discarded — only the buffer rows
+    # below T survive
+    _, _, (tok_buf, lp_buf), _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), init, bufs, count_unfinished(init[2]))
+    )
+    return tok_buf[:T], lp_buf[:T]
 
 
 def fused_decode(
@@ -49,6 +236,8 @@ def fused_decode(
     max_len: int | None = None,
     min_len: int = 0,
     batch_axes: tuple[str, ...] = (),
+    decode_stride: int | None = None,
+    compact: bool | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """-> (greedy [B,T], greedy_lp [B,T], tokens [K,B,T], logprobs [K,B,T]).
 
@@ -56,36 +245,56 @@ def fused_decode(
     consumed); lanes 1..K are the Monte-Carlo rollouts on ``sample_decode``'s
     exact key stream. ``logprobs`` are untempered model logprobs of the
     chosen tokens (``selected_logprob``); PAD/0 after EOS on every lane.
+
+    ``decode_stride`` / ``compact`` default from ``model.cfg``
+    (``decode_stride`` / ``decode_compact``); pass explicit values to
+    override per call (the parity tests and bench sweep do). Stride 1
+    without compaction is the per-step loop every other combination is
+    pinned token/logprob-exact against.
     """
     T = max_len or model.cfg.max_len
     K = num_rollouts
-    enc: EncoderOutput = model.apply(params, feats, masks, method=CaptionModel.encode)
+    S = (
+        decode_stride if decode_stride is not None
+        else getattr(model.cfg, "decode_stride", 1)
+    )
+    S = max(1, min(int(S), T))
+    if compact is None:
+        compact = bool(getattr(model.cfg, "decode_compact", False))
+    if S == 1:
+        # compaction only pays between strides (the per-step kernel takes
+        # no active-prefix, and permuting between every step buys nothing);
+        # stride 1 therefore always means the plain per-step loop
+        compact = False
+    enc: EncoderOutput = model.apply(
+        params, feats, masks, method=CaptionModel.encode
+    )
     B = enc.memory.shape[0]
     step_keys = rollout_step_keys(rng, K, T)  # [T, K] — lane 0 never draws
 
-    def step(state, t):
-        carry, token, finished = state  # carry leaves [1+K, B, ...]; [1+K, B]
-        carry, logits = lane_decode_step(model, params, carry, token, enc)
-        logits = apply_min_len(forbid_special(logits), t, min_len)  # [1+K,B,V]
-        g_nxt = jnp.argmax(logits[0], axis=-1)
-        s_nxt = jax.vmap(
-            lambda k_, l_: jax.random.categorical(k_, l_ / temperature, axis=-1)
-        )(step_keys[t], logits[1:])
-        nxt = jnp.concatenate([g_nxt[None], s_nxt], axis=0).astype(jnp.int32)
-        lp = selected_logprob(logits, nxt)
-        nxt, lp, finished = step_outputs(nxt, lp, finished)
-        return (carry, nxt, finished), (nxt, lp)
-
-    init = (
-        jax.tree.map(
-            lambda x: jnp.broadcast_to(x[None], (1 + K,) + x.shape), enc.carry
-        ),
-        jnp.full((1 + K, B), BOS_ID, jnp.int32),
-        jnp.zeros((1 + K, B), bool),
-    )
-    _, (tokens, logprobs) = scan_until_finished(
-        step, init, T, lambda s: s[2], (PAD_ID, 0.0), batch_axes
-    )
+    if S == 1 and not compact:
+        # the per-step loop: scan_until_finished's fine-grained early exit
+        # (exit check every ~5 steps), the exactness baseline
+        step = _sel_step(
+            model, params, enc, step_keys, B, model.cfg.vocab_size,
+            temperature, min_len, None,
+        )
+        init = (
+            jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (1 + K,) + x.shape),
+                enc.carry,
+            ),
+            jnp.full((1 + K, B), BOS_ID, jnp.int32),
+            jnp.zeros((1 + K, B), bool),
+        )
+        _, (tokens, logprobs) = scan_until_finished(
+            step, init, T, lambda s: s[2], (PAD_ID, 0.0), batch_axes
+        )
+    else:
+        tokens, logprobs = _stride_decode(
+            model, params, enc, step_keys, B, T, S, K, temperature, min_len,
+            compact, batch_axes,
+        )
     # ys stack on axis 0: [T, 1+K, B] -> [1+K, B, T]
     tokens = tokens.transpose(1, 2, 0)
     logprobs = logprobs.transpose(1, 2, 0)

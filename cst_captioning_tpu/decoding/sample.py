@@ -23,6 +23,7 @@ from cst_captioning_tpu.config.config import BOS_ID, PAD_ID
 from cst_captioning_tpu.decoding.common import (
     apply_min_len,
     forbid_special,
+    gumbel_step_noise,
     lane_decode_step,
     rollout_step_keys,
     scan_until_finished,
@@ -65,9 +66,12 @@ def sample_decode(
         carry, token, finished = state  # carry leaves [K, B, ...]; [K, B]
         carry, logits = lane_decode_step(model, params, carry, token, enc)
         logits = apply_min_len(forbid_special(logits), t, min_len)  # [K,B,V]
-        nxt = jax.vmap(
-            lambda k_, l_: jax.random.categorical(k_, l_ / temperature, axis=-1)
-        )(step_keys[t], logits).astype(jnp.int32)
+        # Gumbel-max form of ``categorical(key, logits / temperature)`` —
+        # bit-identical (gumbel_step_noise docstring), and the same selection
+        # the fused stride paths run, so every sampler shares one spelling
+        tl = logits / temperature
+        noise = gumbel_step_noise(step_keys[t], tl.shape[1:], tl.dtype)
+        nxt = jnp.argmax(tl + noise, axis=-1).astype(jnp.int32)
         lp = selected_logprob(logits, nxt)
         nxt, lp, finished = step_outputs(nxt, lp, finished)
         return (carry, nxt, finished), (nxt, lp)

@@ -19,6 +19,7 @@ import functools
 
 import flax.linen as nn
 import flax.struct
+import jax
 import jax.numpy as jnp
 
 from cst_captioning_tpu.config.config import BOS_ID, ModelConfig
@@ -36,6 +37,16 @@ class EncoderOutput:
     memory_proj: jnp.ndarray  # [B, M, d_att] attention key projection
     memory_mask: jnp.ndarray  # [B, M]
     carry: Carry              # initial LSTM carry
+
+    def take_batch(self, idx: jnp.ndarray) -> "EncoderOutput":
+        """Gather batch rows ``idx`` from every leaf (all are batch-major).
+
+        The fused decode's finished-lane compaction permutes still-active
+        batch columns into a dense prefix between strides
+        (decoding/fused.py); the encoder output must follow the same
+        permutation so each row keeps attending over its own memory bank.
+        """
+        return jax.tree.map(lambda x: jnp.take(x, idx, axis=0), self)
 
 
 def shift_right(labels: jnp.ndarray) -> jnp.ndarray:

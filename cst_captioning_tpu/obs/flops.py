@@ -83,23 +83,40 @@ def enc_and_per_tok_flops(
     return float(enc), float(per_tok)
 
 
+def stride_steps(T: int, stride: int = 1) -> int:
+    """Scan-step budget of a strided decode loop: T rounded up to the next
+    stride multiple (the driving loop advances whole strides, so the final
+    partial chunk still steps ``stride`` times)."""
+    s = max(int(stride), 1)
+    return -(-int(T) // s) * s
+
+
 def decode_flops_per_clip(
     K: int, T: int, F: int, d_embed: int, d_hidden: int, d_att: int, V: int,
     feat_dims: tuple[int, ...], num_layers: int = 1,
     with_greedy: bool = True, fused: bool = True,
+    stride: int = 1, active_frac: float = 1.0,
 ) -> float:
     """Matmul FLOPs of one RL decode per clip.
 
     ``fused=True`` (the one-loop default, PR 4): ONE encoder pass feeds both
     the greedy lane and the K sampled lanes. ``fused=False`` is the two-loop
     reference: greedy and sampling each run their own encoder pass.
+
+    ``stride`` rounds the step budget up to whole driving-loop chunks
+    (``decode_stride``); ``active_frac`` scales the per-token work by the
+    fraction of lane-steps actually computed — 1.0 assumes every lane steps
+    the full budget (the uncompacted worst case), while a measured value
+    from the ``rl.decode.compaction`` counters (lanes_stepped /
+    (lanes_stepped + lanes_skipped)) gives the compaction-aware cost.
     """
     enc, per_tok = enc_and_per_tok_flops(
         F, d_embed, d_hidden, d_att, V, feat_dims, num_layers
     )
     lanes = (1 if with_greedy else 0) + K
     enc_passes = 1 if (fused or not with_greedy) else 2
-    return float(enc_passes * enc + lanes * T * per_tok)
+    steps = stride_steps(T, stride)
+    return float(enc_passes * enc + lanes * steps * per_tok * active_frac)
 
 
 def update_flops_per_clip(

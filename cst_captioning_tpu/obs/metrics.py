@@ -238,8 +238,14 @@ def snapshot() -> dict[str, Any]:
 # ---- optional device feeds (lazy jax, graceful everywhere) ------------------
 
 def observe_device_memory(registry: Registry | None = None) -> bool:
-    """Update ``device.bytes_in_use`` / ``device.peak_bytes_in_use`` gauges
-    from ``jax.local_devices()[0].memory_stats()``.
+    """Update memory watermark gauges for EVERY local device.
+
+    Per device ``k``: ``device<k>.bytes_in_use`` /
+    ``device<k>.peak_bytes_in_use`` (+ ``bytes_limit``). The aggregate
+    ``device.*`` gauges carry the max across local devices — on a balanced
+    data-parallel mesh all devices track together, so the max is the HBM
+    headroom signal, and a skewed device (a sharding bug, an uneven last
+    batch) shows up as ``device<k>`` diverging from the aggregate.
 
     Returns False (and writes nothing) when the backend has no memory stats
     (CPU) or jax is unavailable — callers never need to guard. Reading
@@ -249,19 +255,27 @@ def observe_device_memory(registry: Registry | None = None) -> bool:
     try:
         import jax
 
-        stats = jax.local_devices()[0].memory_stats()
+        per_dev = [
+            (d.id, d.memory_stats() or {}) for d in jax.local_devices()
+        ]
     except Exception:
         return False
-    if not stats:
-        return False
-    for key, gname in (
-        ("bytes_in_use", "device.bytes_in_use"),
-        ("peak_bytes_in_use", "device.peak_bytes_in_use"),
-        ("bytes_limit", "device.bytes_limit"),
-    ):
-        if key in stats:
-            reg.gauge(gname).set(float(stats[key]))
-    return True
+    keys = (
+        ("bytes_in_use", "bytes_in_use"),
+        ("peak_bytes_in_use", "peak_bytes_in_use"),
+        ("bytes_limit", "bytes_limit"),
+    )
+    wrote = False
+    for key, gname in keys:
+        vals = [s[key] for _, s in per_dev if key in s]
+        if not vals:
+            continue
+        wrote = True
+        reg.gauge(f"device.{gname}").set(float(max(vals)))
+        for dev_id, stats in per_dev:
+            if key in stats:
+                reg.gauge(f"device{dev_id}.{gname}").set(float(stats[key]))
+    return wrote
 
 
 _COMPILE_LISTENER_INSTALLED = False

@@ -200,6 +200,8 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
     if depth and depth.get("count"):
         budget = float(gauges.get("rl.decode.budget", 0.0))
         mean = depth["sum"] / depth["count"]
+        stepped = float(counters.get("rl.decode.compaction.lanes_stepped", 0))
+        skipped = float(counters.get("rl.decode.compaction.lanes_skipped", 0))
         decode = {
             "batches": depth["count"],
             "depth_mean": mean,
@@ -209,6 +211,15 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
             "budget": budget,
             # share of the T-step budget the early exit skipped
             "saved_frac": (1.0 - mean / budget) if budget > 0 else 0.0,
+            # finished-lane compaction ledger (rl.decode.compaction.*
+            # counter pair, SCSTTrainer._observe_decode): lane-column steps
+            # the driving loop computed vs compacted away
+            "lanes_stepped": stepped,
+            "lanes_skipped": skipped,
+            "compaction_saved_frac": (
+                skipped / (stepped + skipped) if stepped + skipped > 0
+                else 0.0
+            ),
         }
 
     resilience = {
@@ -310,6 +321,13 @@ def render_report(report: dict[str, Any]) -> str:
             f"(mean {d['depth_mean']:.1f} — early exit skips "
             f"{100.0 * d['saved_frac']:.1f}% of the scan budget)"
         )
+        if d["lanes_stepped"] or d["lanes_skipped"]:
+            lines.append(
+                f"decode compaction: {int(d['lanes_stepped'])} lane-steps "
+                f"computed, {int(d['lanes_skipped'])} skipped "
+                f"({100.0 * d['compaction_saved_frac']:.1f}% of lane-steps "
+                "compacted away)"
+            )
     r = report["resilience"]
     lines.append("")
     lines.append("resilience:")

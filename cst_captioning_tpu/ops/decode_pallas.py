@@ -25,18 +25,20 @@ and the memory bank block is fetched once per batch block and reused by all
 post-LSTM hidden is computed at the first vocab block and stashed in
 scratch for the rest.
 
-Boundaries, stated so the kernel can't be over-read:
+TWO kernels share that math:
 
-- the embed gather ``word_emb[token]`` happens OUTSIDE the kernel (one XLA
-  gather per step): keeping the ``[V, E]`` table out of VMEM is what lets
-  the LSTM + attention weights stay resident at the flagship dims, and a
-  [rows, E] gather is already a single optimal HBM op;
-- residency spans one pallas_call, i.e. one time step across all rows and
-  lanes. Cross-step residency (weights pinned across the
-  ``scan_until_finished`` stride) would need token selection inside the
-  kernel; that headroom is recorded in ROADMAP.md;
-- token selection (argmax / ``jax.random.categorical``) stays outside, so
-  the XLA and Pallas impls share one RNG stream and selection semantics.
+- :func:`fused_decode_step` — the PR-4 per-step kernel: one launch per time
+  step, weights resident across the row grid WITHIN the step. The embed
+  gather and token selection stay outside (one XLA gather + argmax/
+  categorical per step), so the XLA and Pallas impls share one RNG stream
+  by construction. Still the kernel behind the greedy/sample loops.
+- :func:`fused_decode_stride` — the multi-step stride kernel (see its
+  section below): token selection and the next-token embedding lookup move
+  IN-kernel, so weights stay resident across a whole stride of S time
+  steps with ONE launch. RNG streams stay bit-identical because the Gumbel
+  noise behind ``jax.random.categorical`` is precomputed outside from the
+  ``rollout_step_keys`` streams and fed in as data. The fused RL decode
+  (decoding/fused.py) drives this one.
 
 Decode never takes gradients (the REINFORCE update teacher-forces through
 its own path), so there is no VJP: differentiating the op raises.
@@ -63,6 +65,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from cst_captioning_tpu.compat import vma_of
+from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID
 from cst_captioning_tpu.models.decoder import LSTM_GATE_ORDER
 
 NEG = -1.0e9
@@ -372,4 +375,478 @@ def fused_decode_step(cell_params, carry, token, memory, memory_proj,
     return _fused_call(
         cell_params, carry, emb, memory, memory_proj, memory_mask,
         block_b, block_v, interpret,
+    )
+
+
+# ---- multi-step stride kernel: token selection moves INSIDE ------------------
+#
+# The per-step kernel above keeps weights resident for one time step; the
+# stride kernel below keeps them resident across S steps by moving token
+# selection and the next-token embedding lookup in-kernel, so the host
+# dispatches ONE pallas_call per stride instead of one per step:
+#
+#   grid (batch-block i, lane g, step s, vocab-block vb) — s and vb are the
+#   two inner (sequential) axes, so for each (i, g) the kernel walks S full
+#   time steps while every decoder weight (grid-invariant index maps) and
+#   the batch block's memory bank (invariant over g, s, vb) stay in VMEM.
+#
+# Selection semantics are EXACTLY the driving loop's (decoding/fused.py):
+# lane 0 takes the first-index argmax of the untempered masked logits;
+# lanes 1..K add precomputed Gumbel noise — jax.random.categorical's own
+# Gumbel-max form, generated OUTSIDE from the [T, K] rollout_step_keys so
+# the RNG streams stay bit-identical to the XLA path (the noise is data;
+# only the argmax moved in-kernel). The blocked argmax keeps categorical's
+# tie-break (lowest index wins: strictly-greater updates across vocab
+# blocks, min-index within one). The chosen token's logprob comes from an
+# online (max, sumexp) pair accumulated over the same vocab blocks.
+#
+# The next token's embedding never needs the [V, E] table resident: while
+# vocab block vb streams through for the output projection, the embedding
+# table block vb streams alongside it, and whenever a row's running argmax
+# improves, that row one-hot-matmuls the candidate's embedding row out of
+# the CURRENT table block into scratch (`pl.when(any(upd))` skips the
+# matmul once the running max stops improving, which it quickly does). At
+# the last vocab block the winner's embedding is already in scratch and
+# becomes step s+1's input; finished rows feed PAD's embedding (stashed
+# from block 0) — the exact frozen-token semantics of `step_outputs`.
+#
+# Finished-lane compaction hooks in through `n_active` (SMEM scalar): the
+# driving loop packs batch columns that still have an unfinished lane into
+# a dense prefix, and batch blocks entirely past the prefix skip attention,
+# LSTM, projection and selection, writing only the frozen PAD/0 outputs and
+# passing their carry through (a fully-finished column can never rejoin, so
+# its stale carry is unobservable — the XLA path keeps stepping such rows,
+# whose outputs are equally frozen). Per-lane raggedness inside an active
+# block still steps (Ragged Paged Attention's per-page skipping is the
+# natural next refinement); the compaction counters in the run report
+# quantify exactly the column-level savings.
+
+def _stride_kernel(*refs, num_layers: int, m_true: int, V: int, S: int,
+                   temperature: float, min_len: int, block_v: int):
+    L = num_layers
+    it = iter(refs)
+    t0_ref, nact_ref = next(it), next(it)
+    emb0_ref, fin0_ref = next(it), next(it)
+    carry_refs = [(next(it), next(it)) for _ in range(L)]
+    mem_ref, proj_ref, mask_ref = next(it), next(it), next(it)
+    wq_ref, bq_ref, v_ref = next(it), next(it), next(it)
+    lstm_refs = [(next(it), next(it), next(it)) for _ in range(L)]
+    wo_ref, bo_ref = next(it), next(it)
+    embt_ref, noise_ref = next(it), next(it)
+    tok_ref, lp_ref = next(it), next(it)
+    carry_out_refs = [(next(it), next(it)) for _ in range(L)]
+    x_scr, embc_scr, embn_scr, pade_scr = (
+        next(it), next(it), next(it), next(it))
+    bv_scr, bi_scr, sl_scr, lm_scr, ls_scr, fin_scr = (
+        next(it), next(it), next(it), next(it), next(it), next(it))
+    cs = [(next(it), next(it)) for _ in range(L)]
+
+    i, g = pl.program_id(0), pl.program_id(1)
+    s, vb = pl.program_id(2), pl.program_id(3)
+    last_vb = vb == pl.num_programs(3) - 1
+    bb = x_scr.shape[0]
+    active = i * bb < nact_ref[0]
+
+    @pl.when(active & (s == 0) & (vb == 0))
+    def _():
+        # per-(i, g) stride state lives in scratch; (re)seed it here
+        embc_scr[:] = emb0_ref[0].astype(jnp.float32)
+        fin_scr[:] = fin0_ref[0][:, None]
+        for layer in range(L):
+            cs[layer][0][:] = carry_refs[layer][0][0].astype(jnp.float32)
+            cs[layer][1][:] = carry_refs[layer][1][0].astype(jnp.float32)
+        # PAD's embedding row (PAD_ID == 0 lives in vocab block 0)
+        pade_scr[:] = embt_ref[PAD_ID, :][None].astype(jnp.float32)
+
+    # per-(lane-block, step) raggedness skip: once EVERY row of this lane's
+    # batch block is finished, the remaining steps of the stride do no
+    # attention/LSTM/projection/selection work — the finalize's frozen
+    # branch (PAD/0 emission, PAD embedding feed) never reads the stale
+    # selection scratch, and a fully-finished row's carry is unobservable
+    # (compaction keeps such rows packed so whole blocks die together)
+    live = active & jnp.any(fin_scr[:] == 0)
+
+    @pl.when(live & (vb == 0))
+    def _():
+        # step s's attention + LSTM stack (the per-step kernel's math)
+        h_top = cs[L - 1][1][:]
+        q = (
+            jnp.dot(h_top, wq_ref[:].astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+            + bq_ref[:].astype(jnp.float32)
+        )
+        t = jnp.tanh(proj_ref[:].astype(jnp.float32) + q[:, None, :])
+        sc = jnp.sum(t * v_ref[0].astype(jnp.float32)[None, None, :], axis=-1)
+        sc = jnp.where(mask_ref[:] > 0, sc, NEG)
+        mcol = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+        sc = jnp.where(mcol < m_true, sc, -jnp.inf)
+        m = jnp.max(sc, axis=-1, keepdims=True)
+        p = jnp.exp(sc - m)
+        w = p / jnp.sum(p, axis=-1, keepdims=True)
+        ctx = jnp.sum(w[:, :, None] * mem_ref[:].astype(jnp.float32), axis=1)
+        x = jnp.concatenate([embc_scr[:], ctx], axis=-1)
+        for layer in range(L):
+            wi_ref, wh_ref, b_ref = lstm_refs[layer]
+            c_new, h_new = _lstm_math(
+                x, cs[layer][0][:], cs[layer][1][:],
+                wi_ref[:].astype(jnp.float32),
+                wh_ref[:].astype(jnp.float32),
+                b_ref[:].astype(jnp.float32),
+            )
+            cs[layer][0][:] = c_new
+            cs[layer][1][:] = h_new
+            x = h_new
+        x_scr[:] = x
+        # reset the per-step online selection / logsumexp state (-inf is
+        # safe: every vocab block holds >= 1 real column, so the running
+        # max is finite from the first block on — no inf-inf NaN path)
+        bv_scr[:] = jnp.full_like(bv_scr[:], -jnp.inf)
+        bi_scr[:] = jnp.zeros_like(bi_scr[:])
+        sl_scr[:] = jnp.zeros_like(sl_scr[:])
+        lm_scr[:] = jnp.full_like(lm_scr[:], -jnp.inf)
+        ls_scr[:] = jnp.zeros_like(ls_scr[:])
+
+    @pl.when(live)
+    def _():
+        logits = (
+            jnp.dot(x_scr[:], wo_ref[:].astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+            + bo_ref[:].astype(jnp.float32)
+        )                                                   # [bb, block_v]
+        col = vb * block_v + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1
+        )
+        # forbid_special + apply_min_len, in-kernel
+        logits = jnp.where((col == PAD_ID) | (col == BOS_ID), NEG, logits)
+        if min_len > 0:
+            t_glob = t0_ref[0] + s
+            logits = jnp.where(
+                (t_glob < min_len) & (col == EOS_ID), NEG, logits
+            )
+        lm = jnp.where(col < V, logits, -jnp.inf)  # padding cols: excluded
+        # online logsumexp over the untempered masked logits (selected_logprob)
+        bm = jnp.max(lm, axis=-1, keepdims=True)
+        m_new = jnp.maximum(lm_scr[:], bm)
+        ls_scr[:] = (
+            ls_scr[:] * jnp.exp(lm_scr[:] - m_new)
+            + jnp.sum(jnp.exp(lm - m_new), axis=-1, keepdims=True)
+        )
+        lm_scr[:] = m_new
+        # selection value: untempered argmax on lane 0, Gumbel-max draw on
+        # the sampled lanes (noise precomputed from rollout_step_keys)
+        sel = jnp.where(
+            g == 0, lm, lm / temperature + noise_ref[0, 0]
+        )
+        bm_s = jnp.max(sel, axis=-1, keepdims=True)
+        cand = jnp.min(
+            jnp.where(sel == bm_s, col, 2**30), axis=-1, keepdims=True
+        )                       # first-max tie-break: lowest column id wins
+        upd = bm_s > bv_scr[:]  # strict >: the earliest block keeps ties
+        cand_lm = jnp.sum(
+            jnp.where(col == cand, lm, 0.0), axis=-1, keepdims=True
+        )
+        bv_scr[:] = jnp.where(upd, bm_s, bv_scr[:])
+        bi_scr[:] = jnp.where(upd, cand, bi_scr[:])
+        sl_scr[:] = jnp.where(upd, cand_lm, sl_scr[:])
+
+        @pl.when(jnp.any(upd))
+        def _():
+            # candidate embedding: one-hot row-select out of the CURRENT
+            # table block (an MXU matmul, not a gather); skipped entirely
+            # once no row's running argmax improves
+            onehot = (col == cand).astype(jnp.float32)
+            cand_emb = jnp.dot(
+                onehot, embt_ref[:].astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            embn_scr[:] = jnp.where(upd, cand_emb, embn_scr[:])
+
+    @pl.when(active & last_vb)
+    def _():
+        # finalize step s: freeze finished rows (step_outputs semantics)
+        fin = fin_scr[:] > 0
+        tok = jnp.where(fin, jnp.int32(PAD_ID), bi_scr[:])
+        lse = lm_scr[:] + jnp.log(ls_scr[:])
+        lp = jnp.where(fin, 0.0, sl_scr[:] - lse)
+        tok_ref[0, 0] = tok[:, 0]
+        lp_ref[0, 0] = lp[:, 0]
+        fin_scr[:] = jnp.logical_or(fin, tok == EOS_ID).astype(jnp.int32)
+        embc_scr[:] = jnp.where(fin, pade_scr[:], embn_scr[:])
+
+    @pl.when(active & (s == S - 1) & last_vb)
+    def _():
+        for layer in range(L):
+            c_out, h_out = carry_out_refs[layer]
+            c_out[0] = cs[layer][0][:].astype(c_out.dtype)
+            h_out[0] = cs[layer][1][:].astype(h_out.dtype)
+
+    # compacted-away blocks (every column fully finished): frozen outputs,
+    # carry passthrough — no attention/LSTM/projection/selection work
+    @pl.when(jnp.logical_not(active) & last_vb)
+    def _():
+        tok_ref[0, 0] = jnp.full((bb,), PAD_ID, jnp.int32)
+        # frozen-row logprobs are f32 by the output contract
+        lp_ref[0, 0] = jnp.zeros((bb,), jnp.float32)  # graftlint: disable=GL005
+
+    @pl.when(jnp.logical_not(active) & (s == S - 1) & last_vb)
+    def _():
+        for layer in range(L):
+            c_out, h_out = carry_out_refs[layer]
+            c_out[0] = carry_refs[layer][0][0]
+            h_out[0] = carry_refs[layer][1][0]
+
+
+def _reference_stride(cell_params, carry, token, finished, memory,
+                      memory_proj, memory_mask, noise, t0, *, steps: int,
+                      temperature: float, min_len: int):
+    """The stride kernel as a plain-jnp composite: S chained `_reference`
+    steps with the driving loop's exact selection semantics (first-max
+    argmax on lane 0, Gumbel-max on lanes 1..K from the provided noise,
+    `selected_logprob` logprobs, `step_outputs` freezing) — the
+    interpret-mode shard_map fallback and the parity oracle."""
+    toks, lps = [], []
+    for s in range(steps):
+        carry, logits = _reference(
+            cell_params, carry, token, memory, memory_proj, memory_mask
+        )
+        neg = jnp.full_like(logits[..., :1], NEG)
+        logits = (
+            logits.at[..., PAD_ID].set(neg[..., 0])
+            .at[..., BOS_ID].set(neg[..., 0])
+        )
+        if min_len > 0:
+            blocked = logits.at[..., EOS_ID].set(NEG)
+            logits = jnp.where(t0 + s < min_len, blocked, logits)
+        g_nxt = jnp.argmax(logits[0], axis=-1)
+        s_nxt = jnp.argmax(logits[1:] / temperature + noise[s], axis=-1)
+        nxt = jnp.concatenate([g_nxt[None], s_nxt], axis=0).astype(jnp.int32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        lp = jnp.take_along_axis(logits, nxt[..., None], axis=-1)[..., 0] - lse
+        nxt = jnp.where(finished, jnp.full_like(nxt, PAD_ID), nxt)
+        lp = jnp.where(finished, jnp.zeros_like(lp), lp)
+        finished = finished | (nxt == EOS_ID)
+        toks.append(nxt)
+        lps.append(lp)
+        token = nxt
+    return carry, jnp.stack(toks), jnp.stack(lps)
+
+
+def _stride_call(cell_params, carry, emb0, finished, memory, memory_proj,
+                 memory_mask, noise, t0, n_active, *, S: int,
+                 temperature: float, min_len: int, block_b: int,
+                 block_v: int, interpret: bool):
+    L = _num_layers(cell_params)
+    G, B, E = emb0.shape
+    M = memory.shape[1]
+    Em = memory.shape[2]
+    A = memory_proj.shape[2]
+    H = carry[0][0].shape[-1]
+    wo = cell_params["out_proj"]["kernel"]
+    bo = cell_params["out_proj"]["bias"][None, :]
+    embt = jnp.asarray(cell_params["word_embed"]["embedding"])
+    V = wo.shape[-1]
+
+    block_b = min(block_b, B) if B else block_b
+    Bp = -(-B // block_b) * block_b
+    block_v = min(block_v, -(-V // 128) * 128 if V > 128 else V)
+    Vp = -(-V // block_v) * block_v
+    Mp = -(-M // 128) * 128 if not interpret else M
+
+    emb0p = _pad_to(emb0, 1, block_b)
+    # padded rows are born finished: their outputs freeze to PAD/0
+    fin0p = _pad_to(finished.astype(jnp.int32), 1, block_b, value=1)
+    carryp = [
+        (_pad_to(c, 1, block_b), _pad_to(h, 1, block_b)) for c, h in carry
+    ]
+    memp = _pad_to(_pad_to(memory, 0, block_b), 1, Mp)
+    projp = _pad_to(_pad_to(memory_proj, 0, block_b), 1, Mp)
+    maskp = _pad_to(_pad_to(memory_mask, 0, block_b), 1, Mp)
+    wop = _pad_to(wo, 1, block_v)
+    bop = _pad_to(bo, 1, block_v)
+    embtp = _pad_to(embt, 0, block_v)
+    noisep = _pad_to(_pad_to(noise, 2, block_b), 3, block_v)
+    Mp = maskp.shape[1]
+
+    att = cell_params["attention"]
+    wq = att["query_proj"]["kernel"]
+    bq = att["query_proj"]["bias"][None, :]
+    vs = att["score"]["kernel"][:, 0][None, :]
+
+    smem = pl.BlockSpec((1,), lambda i, g, s, vb: (0,),
+                        memory_space=pltpu.SMEM)
+    const = lambda i, g, s, vb: (0, 0)   # noqa: E731 — grid-invariant
+    in_specs = [smem, smem]
+    args = [
+        jnp.asarray(t0, jnp.int32).reshape(1),
+        jnp.asarray(n_active, jnp.int32).reshape(1),
+    ]
+    in_specs += [
+        pl.BlockSpec((1, block_b, E), lambda i, g, s, vb: (g, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_b), lambda i, g, s, vb: (g, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [emb0p, fin0p]
+    for c, h in carryp:
+        for arr in (c, h):
+            in_specs.append(
+                pl.BlockSpec((1, block_b, H), lambda i, g, s, vb: (g, i, 0),
+                             memory_space=pltpu.VMEM)
+            )
+            args.append(arr)
+    in_specs += [
+        pl.BlockSpec((block_b, Mp, Em), lambda i, g, s, vb: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, Mp, A), lambda i, g, s, vb: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, Mp), lambda i, g, s, vb: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((H, A), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, A), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, A), const, memory_space=pltpu.VMEM),
+    ]
+    args += [memp, projp, maskp, wq, bq, vs]
+    for layer in range(L):
+        wi, wh, b = _gate_weights(cell_params[f"lstm{layer}"])
+        in_specs += [
+            pl.BlockSpec(wi.shape, const, memory_space=pltpu.VMEM),
+            pl.BlockSpec(wh.shape, const, memory_space=pltpu.VMEM),
+            pl.BlockSpec(b.shape, const, memory_space=pltpu.VMEM),
+        ]
+        args += [wi, wh, b]
+    in_specs += [
+        pl.BlockSpec((H, block_v), lambda i, g, s, vb: (0, vb),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_v), lambda i, g, s, vb: (0, vb),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_v, E), lambda i, g, s, vb: (vb, 0),
+                     memory_space=pltpu.VMEM),
+        # lane 0 draws no noise; its (unused) block aliases lane 1's so the
+        # fetch is a repeat, not extra traffic
+        pl.BlockSpec((1, 1, block_b, block_v),
+                     lambda i, g, s, vb: (s, jnp.maximum(g - 1, 0), i, vb),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [wop, bop, embtp, noisep]
+
+    vma = frozenset()
+    for x in (emb0, memory, memory_proj, memory_mask, finished, noise,
+              *jax.tree.leaves(carry)):
+        vma = vma | vma_of(x)
+    sds = (
+        (lambda sh, d: jax.ShapeDtypeStruct(sh, d, vma=vma)) if vma
+        else jax.ShapeDtypeStruct
+    )
+    out_shape = [sds((S, G, Bp), jnp.int32), sds((S, G, Bp), jnp.float32)]
+    out_specs = [
+        pl.BlockSpec((1, 1, block_b), lambda i, g, s, vb: (s, g, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_b), lambda i, g, s, vb: (s, g, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    for c, h in carry:
+        for arr in (c, h):
+            out_shape.append(sds((G, Bp, H), arr.dtype))
+            out_specs.append(
+                pl.BlockSpec((1, block_b, H), lambda i, g, s, vb: (g, i, 0),
+                             memory_space=pltpu.VMEM)
+            )
+
+    scratch = [
+        pltpu.VMEM((block_b, H), jnp.float32),    # x_stash
+        pltpu.VMEM((block_b, E), jnp.float32),    # current-step embedding
+        pltpu.VMEM((block_b, E), jnp.float32),    # candidate embedding
+        pltpu.VMEM((1, E), jnp.float32),          # PAD embedding
+        pltpu.VMEM((block_b, 1), jnp.float32),    # running best sel value
+        pltpu.VMEM((block_b, 1), jnp.int32),      # running best token
+        pltpu.VMEM((block_b, 1), jnp.float32),    # its untempered logit
+        pltpu.VMEM((block_b, 1), jnp.float32),    # online lse max
+        pltpu.VMEM((block_b, 1), jnp.float32),    # online lse sumexp
+        pltpu.VMEM((block_b, 1), jnp.int32),      # finished
+    ]
+    for _ in range(L):
+        scratch += [
+            pltpu.VMEM((block_b, H), jnp.float32),
+            pltpu.VMEM((block_b, H), jnp.float32),
+        ]
+
+    grid = (Bp // block_b, G, S, Vp // block_v)
+    outs = pl.pallas_call(
+        functools.partial(
+            _stride_kernel, num_layers=L, m_true=M, V=V, S=S,
+            temperature=temperature, min_len=min_len, block_v=block_v,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+    tokens = outs[0][:, :, :B]
+    lps = outs[1][:, :, :B]
+    flat = outs[2:]
+    new_carry = tuple(
+        (flat[2 * layer][:, :B], flat[2 * layer + 1][:, :B])
+        for layer in range(L)
+    )
+    return new_carry, tokens, lps
+
+
+def fused_decode_stride(cell_params, carry, token, finished, memory,
+                        memory_proj, memory_mask, noise, t0, n_active=None,
+                        *, steps: int, temperature: float = 1.0,
+                        min_len: int = 0, num_layers: int | None = None,
+                        block_b: int = 32, block_v: int = 1024):
+    """S fused decode steps with in-kernel token selection.
+
+    -> ``(new_carry, tokens [S, G, B] int32, logprobs [S, G, B] f32)``.
+
+    Args beyond :func:`fused_decode_step`'s: ``finished`` [G, B] bool (rows
+    already past EOS — they emit PAD/0 and feed PAD forward), ``noise``
+    [S, K, B, V] f32 Gumbel noise for the sampled lanes (generated from the
+    exact ``rollout_step_keys`` streams by the driving loop — see
+    ``decoding.common.gumbel_step_noise``), ``t0`` the global index of the
+    stride's first step (for ``min_len`` masking), and ``n_active`` the
+    compaction prefix length in batch columns (None/B = no compaction —
+    every block steps). Lane 0 is the greedy lane: untempered first-index
+    argmax, no noise consumed. Inference-only, like the per-step kernel.
+    """
+    if num_layers is not None and num_layers != _num_layers(cell_params):
+        raise ValueError(
+            f"num_layers {num_layers} does not match the "
+            f"{_num_layers(cell_params)} lstm layers in cell_params"
+        )
+    G, B = token.shape
+    if G < 2:
+        raise ValueError(
+            "fused_decode_stride needs the (1+K)-lane layout with K >= 1 "
+            f"sampled lanes; got G={G}"
+        )
+    if noise.shape[:3] != (steps, G - 1, B):
+        raise ValueError(
+            f"noise shape {noise.shape} does not match "
+            f"[steps={steps}, K={G - 1}, B={B}, V]"
+        )
+    if n_active is None:
+        n_active = B
+    interpret = jax.default_backend() != "tpu"
+    if interpret and any(
+        vma_of(x)
+        for x in (memory, memory_proj, memory_mask, finished, noise,
+                  *jax.tree.leaves(carry))
+    ):
+        # Pallas interpret mode can't run under a varying-axis-checked
+        # shard_map — the composite carries it (CPU tests only)
+        return _reference_stride(
+            cell_params, carry, token, finished, memory, memory_proj,
+            memory_mask, noise, t0, steps=steps, temperature=temperature,
+            min_len=min_len,
+        )
+    emb0 = jnp.asarray(cell_params["word_embed"]["embedding"])[token]
+    return _stride_call(
+        cell_params, carry, emb0, finished, memory, memory_proj, memory_mask,
+        noise, t0, n_active, S=steps, temperature=temperature,
+        min_len=min_len, block_b=block_b, block_v=block_v,
+        interpret=interpret,
     )

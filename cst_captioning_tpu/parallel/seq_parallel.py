@@ -98,6 +98,17 @@ def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
     ``fused=True`` (default) folds the greedy baseline in as lane 0 of the
     rollout scan — one loop, one encoder pass (decoding/fused.py), pinned
     bit-exact against the two-loop ``fused=False`` reference.
+
+    The fused loop's stride/compaction knobs (``model.decode_stride`` /
+    ``decode_compact``) compose with SP: the compaction permutation is
+    derived from ``finished``, which sits downstream of the attention psum
+    and is therefore 'seq'-invariant — every frame shard gathers the same
+    batch columns, and the frame-sharded memory follows the gather
+    unchanged. Under DP x SP the permutation varies over 'data' only (each
+    batch shard compacts its own columns) and the early-exit count psums
+    over 'data', exactly like the 1-D path. ``decode_impl="pallas"``
+    remains excluded here (config validation): the stride kernel's
+    in-kernel softmax cannot express the collective 'seq' reduction.
     """
     f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
     b = data_axis if data_axis else None

@@ -47,6 +47,51 @@ from cst_captioning_tpu.train.state import TrainState
 from cst_captioning_tpu.train.steps import _apply
 
 
+def compaction_stats(greedy_np, samples_np, stride: int, budget: int,
+                     compact: bool = True) -> dict:
+    """Host-side decode ledger from already-decoded tokens (no device reads).
+
+    -> ``{depth, lanes_stepped, lanes_skipped}``: the scan depth the
+    early-exit loop ran (next ``stride`` multiple of the longest row,
+    capped at the padded budget), and how many (lane, batch-column) steps
+    the compacted decode computed vs skipped. A lane stops computing after
+    its own (EOS-inclusive) length: compaction packs still-active columns
+    into a dense prefix and the stride kernel additionally skips a lane's
+    batch block once every row in it is finished, so the row-granular
+    ledger here is ``sum(min(len, depth))`` stepped out of ``G*B*depth``
+    total (block granularity makes the realized kernel savings slightly
+    lower — a block dies only when its last row does). Without ``compact``
+    every lane rides to the global early exit. Shared by ``SCSTTrainer``
+    (the ``rl.decode.compaction`` counter pair) and ``bench_decode.py``
+    (the tokens-stepped-saved column), so the two reports can't drift.
+    """
+    lanes = []
+    if greedy_np is not None and np.asarray(greedy_np).size:
+        lanes.append(np.asarray(greedy_np)[None])
+    if samples_np is not None and np.asarray(samples_np).size:
+        lanes.append(np.asarray(samples_np))
+    if not lanes:
+        return {"depth": 0, "lanes_stepped": 0, "lanes_skipped": 0}
+    toks = np.concatenate(lanes, axis=0)                      # [G, B, T]
+    G, B, _ = toks.shape
+    stride = max(int(stride), 1)
+    padded = -(-int(budget) // stride) * stride
+    lens = (toks != PAD_ID).sum(axis=-1)                      # [G, B]
+    depth = min(
+        padded, stride * -(-max(int(lens.max()), 1) // stride)
+    )
+    total = G * B * depth
+    if compact:
+        stepped = int(np.minimum(lens, depth).sum())
+    else:
+        stepped = total
+    return {
+        "depth": int(depth),
+        "lanes_stepped": stepped,
+        "lanes_skipped": int(total - stepped),
+    }
+
+
 def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
                    max_len: int | None = None,
                    with_greedy: bool = True, fused: bool = True) -> Callable:
@@ -399,10 +444,21 @@ class SCSTTrainer:
             num_layers=mc.num_layers,
         )
         self._depth_budget = max_len or mc.max_len
-        self._depth_stride = _exit_stride(self._depth_budget)
+        # exit-check granularity of the decode actually dispatched: the
+        # strided driver checks every decode_stride steps; the stride-1
+        # uncompacted loop keeps scan_until_finished's ~5-step divisor
+        decode_stride = max(
+            1, min(int(getattr(mc, "decode_stride", 1)), self._depth_budget)
+        )
+        self._compact = bool(getattr(mc, "decode_compact", False))
+        self._depth_stride = (
+            decode_stride if decode_stride > 1 or self._compact
+            else _exit_stride(self._depth_budget)
+        )
         self._decode_flops_per_clip = _flops.decode_flops_per_clip(
             K=cfg.num_rollouts, T=self._depth_budget,
-            with_greedy=(cfg.baseline == "greedy"), **dims,
+            with_greedy=(cfg.baseline == "greedy"),
+            stride=self._depth_stride, **dims,
         )
         self._update_flops_per_clip = _flops.update_flops_per_clip(
             K=cfg.num_rollouts, T=self._depth_budget, **dims,
@@ -540,10 +596,12 @@ class SCSTTrainer:
 
     def _observe_decode(self, greedy_np, samples_np) -> None:
         """Decode accounting from the already-on-host tokens: the analytic
-        FLOPs counter behind the report's MFU column, and the early-exit
-        depth histogram (scan steps the while loop actually ran vs the T
-        budget — what ``scan_until_finished`` saves per batch). Both are
-        derived from this process's local rows; no device reads."""
+        FLOPs counter behind the report's MFU column, the early-exit depth
+        histogram (scan steps the while loop actually ran vs the T budget),
+        and the ``rl.decode.compaction`` counter pair — (lane, column)
+        steps the compacted driver computed vs skipped (what finished-lane
+        compaction saves per batch; ``cli.obs_report`` surfaces the pair).
+        All derived from this process's local rows; no device reads."""
         obs.counter("flops.rl.decode").inc(
             samples_np.shape[1] * self._decode_flops_per_clip
         )
@@ -552,13 +610,19 @@ class SCSTTrainer:
         # rows finish at their (EOS-inclusive) length; the loop checks the
         # exit every `stride` steps, so it runs to the next stride multiple
         # of the longest row, capped at the padded budget
-        lmax = int((samples_np != PAD_ID).sum(axis=-1).max()) if samples_np.size else 0
-        if greedy_np is not None and greedy_np.size:
-            lmax = max(lmax, int((greedy_np != PAD_ID).sum(axis=-1).max()))
-        stride = self._depth_stride
-        padded = -(-self._depth_budget // stride) * stride
-        depth = min(padded, stride * -(-max(lmax, 1) // stride))
-        obs.histogram("rl.decode.depth", self._DEPTH_BUCKETS).observe(depth)
+        stats = compaction_stats(
+            greedy_np, samples_np, self._depth_stride, self._depth_budget,
+            compact=self._compact,
+        )
+        obs.histogram("rl.decode.depth", self._DEPTH_BUCKETS).observe(
+            stats["depth"]
+        )
+        obs.counter("rl.decode.compaction.lanes_stepped").inc(
+            stats["lanes_stepped"]
+        )
+        obs.counter("rl.decode.compaction.lanes_skipped").inc(
+            stats["lanes_skipped"]
+        )
 
     def _apply(self, state, advantage, host_metrics, samples, feats, masks,
                valid_np):

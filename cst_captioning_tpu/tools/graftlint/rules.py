@@ -945,3 +945,149 @@ class AdHocTimingRule(Rule):
                     "buffer",
                 ))
         return out
+
+
+# ---- GL011: scan-carry dtype drift ------------------------------------------
+
+# jnp array constructors whose dtype is the literal `dtype=` kw (or the f32
+# default when omitted) — the only leaves the rule can reason about without
+# a type system
+_GL011_CTORS = {"zeros", "ones", "full", "empty"}
+_GL011_DTYPE_KW_CTORS = {"array", "asarray", "arange"}
+
+
+@register
+class ScanCarryDtypeRule(Rule):
+    id = "GL011"
+    name = "scan-carry-dtype-drift"
+    severity = "error"
+    rationale = (
+        "a lax.scan / while_loop body whose carry comes back in a "
+        "different dtype than its init fails jaxpr type-checking at best — "
+        "and at worst silently widens/narrows an accumulator every "
+        "iteration (f32 init + bf16-cast update); keep the carry dtype "
+        "loop-invariant"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        defs: dict[str, ast.AST] = {}
+        assigns: dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FUNC_NODES):
+                defs[node.name] = node
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    # last write wins — good enough for the literal inits
+                    # this rule reasons about
+                    assigns[tgt.id] = node.value
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _last(_dotted(node.func))
+            if kind == "scan" and len(node.args) >= 2:
+                body_arg, init_arg = node.args[0], node.args[1]
+            elif kind == "while_loop" and len(node.args) >= 3:
+                body_arg, init_arg = node.args[1], node.args[2]
+            else:
+                continue
+            body = self._resolve(body_arg, defs)
+            if body is None:
+                continue
+            init_leaves = self._leaves(init_arg, assigns)
+            for ret in self._carry_returns(body, kind):
+                ret_leaves = self._leaves(ret, assigns)
+                if len(init_leaves) != len(ret_leaves):
+                    continue  # structure unknown — out of scope
+                for (d_init, _), (d_ret, leaf) in zip(
+                    init_leaves, ret_leaves
+                ):
+                    if d_init and d_ret and d_init != d_ret:
+                        out.append(ctx.finding(
+                            self, leaf,
+                            f"scan/while carry leaf returns dtype "
+                            f"{d_ret!r} but its init is {d_init!r}: the "
+                            "carry dtype must be loop-invariant — cast the "
+                            "init (or drop the per-iteration cast) so "
+                            "input and output types agree",
+                        ))
+        return out
+
+    @staticmethod
+    def _resolve(arg, defs):
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            return defs.get(arg.id)
+        return None
+
+    @staticmethod
+    def _carry_returns(body, kind) -> list[ast.AST]:
+        """Carry expressions returned by a scan/while body.
+
+        scan bodies return ``(carry, y)`` — the carry is element 0;
+        while bodies return the whole carry. Lambdas return their body."""
+        rets: list[ast.AST] = []
+        if isinstance(body, ast.Lambda):
+            exprs = [body.body]
+        else:
+            # this function's own returns only — skip nested defs/lambdas
+            exprs = []
+            stack = list(body.body)
+            while stack:
+                n = stack.pop()
+                if isinstance(n, _FUNC_NODES + (ast.Lambda,)):
+                    continue
+                if isinstance(n, ast.Return) and n.value is not None:
+                    exprs.append(n.value)
+                stack.extend(ast.iter_child_nodes(n))
+        for expr in exprs:
+            if kind == "scan":
+                if isinstance(expr, ast.Tuple) and len(expr.elts) == 2:
+                    rets.append(expr.elts[0])
+            else:
+                rets.append(expr)
+        return rets
+
+    @classmethod
+    def _leaves(cls, expr, assigns) -> list[tuple[str | None, ast.AST]]:
+        """Flatten one tuple level into (dtype-or-None, node) leaves."""
+        if isinstance(expr, ast.Name) and expr.id in assigns:
+            expr = assigns[expr.id]
+        if isinstance(expr, ast.Tuple):
+            return [
+                (cls._dtype_of(e, assigns), e) for e in expr.elts
+            ]
+        return [(cls._dtype_of(expr, assigns), expr)]
+
+    @classmethod
+    def _dtype_of(cls, expr, assigns) -> str | None:
+        """Literal dtype of an expression, when statically evident."""
+        if isinstance(expr, ast.Name) and expr.id in assigns:
+            expr = assigns[expr.id]
+        if not isinstance(expr, ast.Call):
+            return None
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr == "astype":
+            return cls._dtype_name(expr.args[0]) if expr.args else None
+        name = _last(_dotted(expr.func))
+        for kw in expr.keywords:
+            if kw.arg == "dtype":
+                return cls._dtype_name(kw.value)
+        if name in _GL011_CTORS:
+            # second positional arg of zeros/ones/full(shape[, fill], dtype)
+            # is the dtype for zeros/ones; full's is the fill value
+            if name in ("zeros", "ones", "empty") and len(expr.args) >= 2:
+                return cls._dtype_name(expr.args[1])
+            return "float32"  # jnp default
+        return None
+
+    @staticmethod
+    def _dtype_name(node) -> str | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        d = _last(_dotted(node))
+        return d or None

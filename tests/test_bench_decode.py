@@ -29,11 +29,26 @@ def test_bench_decode_smoke_runs_and_reports():
     assert json_lines, proc.stdout[-2000:]
     out = json.loads(json_lines[-1])
     assert out["metric"] == "rl_decode_seconds_per_step"
-    assert set(out["impls"]) == {"two_loop_xla", "fused_xla", "fused_pallas"}
+    assert set(out["impls"]) == {
+        "two_loop_xla", "fused_xla", "fused_xla_s4", "fused_pallas",
+        "fused_pallas_s4",
+    }
     for r in out["impls"].values():
         assert r["seconds_per_step"] > 0
         assert r["flops"] > 0 and r["bytes"] > 0
+        assert {"lanes_stepped", "lanes_skipped", "saved_frac"} <= set(
+            r["compaction"]
+        )
     assert out["parity"]["fused_xla_greedy_bit_exact"] is True
     assert out["parity"]["fused_xla_samples_bit_exact"] is True
+    # the stride+compaction row is BIT-exact vs the stride-1 fused loop,
+    # and the in-kernel selection parity covers f32 AND bf16
+    assert out["parity"]["fused_xla_s4_bit_exact"] is True
+    assert out["parity"]["fused_pallas_s4_token_match_frac"] >= 0.9
+    assert out["parity"]["in_kernel_selection_bf16_token_match_frac"] >= 0.8
+    # the compacted rows actually skip work (EOS-biased bench params)
+    assert out["impls"]["fused_xla_s4"]["compaction"]["lanes_skipped"] > 0
+    # the acceptance field is machine-checkable off-TPU
+    assert out["vs_r05_two_loop"] == "skipped_non_tpu"
     # smoke must not clobber the committed TPU BENCH_DECODE.json
     assert "BENCH_DECODE.json" not in proc.stderr

@@ -305,3 +305,136 @@ def test_min_len_suppresses_early_eos(setup):
         lengths = (tokens != PAD_ID).sum(axis=1)
         assert (lengths >= 3).all(), tokens
         assert not (tokens[:, :2] == EOS_ID).any()
+
+
+# ---- stride + compaction (decode endgame) -----------------------------------
+
+def test_gumbel_step_noise_is_categorical_bitwise():
+    """The Gumbel-max spelling (noise precomputed via gumbel_step_noise,
+    argmax outside) is BIT-IDENTICAL to the vmapped jax.random.categorical
+    it replaced — the invariant that lets the fused stride paths (and the
+    in-kernel selection) reuse the exact sample_decode RNG streams."""
+    from cst_captioning_tpu.decoding.common import gumbel_step_noise
+
+    key = jax.random.key(9)
+    keys = jax.vmap(lambda k: jax.random.fold_in(key, k))(jnp.arange(4))
+    logits = jnp.asarray(
+        np.random.default_rng(3).normal(size=(4, 7, 13)) * 5, jnp.float32
+    )
+    for temp in (1.0, 0.7):
+        want = jax.vmap(
+            lambda k_, l_: jax.random.categorical(k_, l_ / temp, axis=-1)
+        )(keys, logits)
+        tl = logits / temp
+        noise = gumbel_step_noise(keys, tl.shape[1:], tl.dtype)
+        got = jnp.argmax(tl + noise, axis=-1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.fixture(scope="module")
+def eos_setup():
+    """Like ``setup`` but with the EOS logit nudged up so lanes finish at
+    varied steps — random EOS patterns are what compaction must survive."""
+    cfg = ModelConfig(
+        vocab_size=V,
+        modalities=(("resnet", 8),),
+        d_embed=12,
+        d_hidden=12,
+        d_att=6,
+        encoder="temporal_attention",
+        max_len=T,
+        max_frames=F,
+        dtype="float32",
+    )
+    model = CaptionModel(cfg)
+    rng = np.random.default_rng(7)
+    feats = {"resnet": jnp.asarray(rng.normal(size=(B, F, 8)), jnp.float32)}
+    masks = {"resnet": jnp.ones((B, F), jnp.float32)}
+    labels = jnp.asarray(rng.integers(4, V, size=(B, T)), jnp.int32)
+    params = model.init(jax.random.key(1), feats, masks, labels)
+    bias = params["params"]["cell"]["out_proj"]["bias"]
+    params["params"]["cell"]["out_proj"]["bias"] = bias.at[EOS_ID].add(1.5)
+    return model, params, feats, masks
+
+
+def test_fused_stride_compaction_token_and_logprob_exact(eos_setup):
+    """EVERY (stride, compact) combination is bit-equal — tokens AND
+    logprobs, greedy AND sampled lanes — to the stride-1 uncompacted loop
+    under a fixed rng, across random EOS patterns (lanes finish at varied
+    steps, so the compaction permutation is exercised for real). Covers the
+    stride-boundary case (S=4 not dividing T=6) and S > T clamping."""
+    model, params, feats, masks = eos_setup
+    rng = jax.random.key(42)
+    K = 3
+    ref = fused_decode(
+        model, params, feats, masks, rng, num_rollouts=K,
+        decode_stride=1, compact=False,
+    )
+    # sanity: the EOS nudge produced genuinely ragged finishes
+    lens = (np.asarray(ref[2]) != PAD_ID).sum(-1)
+    assert lens.min() < T or lens.max() == T
+    # stride 1 + compact normalizes to the plain loop (fused_decode), so
+    # the compacted combinations all have S >= 2
+    for stride, compact in [(1, True), (2, True), (3, True), (4, True),
+                            (4, False), (6, True), (16, True), (8, True)]:
+        got = fused_decode(
+            model, params, feats, masks, rng, num_rollouts=K,
+            decode_stride=stride, compact=compact,
+        )
+        for a, b, what in zip(got, ref, ("g", "glp", "s", "slp")):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"stride={stride} compact={compact} {what}",
+            )
+
+
+def test_fused_stride_default_knobs_from_config(eos_setup):
+    """fused_decode reads decode_stride / decode_compact off the model
+    config when not overridden — and the config defaults (stride 8,
+    compaction on) stay bit-exact vs the explicit stride-1 call."""
+    import dataclasses
+
+    model, params, feats, masks = eos_setup
+    assert model.cfg.decode_stride == 8 and model.cfg.decode_compact
+    rng = jax.random.key(5)
+    ref = fused_decode(
+        model, params, feats, masks, rng, num_rollouts=2,
+        decode_stride=1, compact=False,
+    )
+    by_default = fused_decode(
+        model, params, feats, masks, rng, num_rollouts=2
+    )
+    m2 = CaptionModel(
+        dataclasses.replace(model.cfg, decode_stride=3, decode_compact=False)
+    )
+    by_cfg = fused_decode(m2, params, feats, masks, rng, num_rollouts=2)
+    for got in (by_default, by_cfg):
+        for a, b in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_stride_under_jit_and_temperature(eos_setup):
+    """The strided+compacted loop jits (one compiled program, traced
+    while loop) and keeps temperature semantics: sampled lanes tempered,
+    greedy lane untempered — still bit-equal to the stride-1 loop."""
+    model, params, feats, masks = eos_setup
+    rng = jax.random.key(12)
+    ref = fused_decode(
+        model, params, feats, masks, rng, num_rollouts=2, temperature=0.6,
+        decode_stride=1, compact=False,
+    )
+    got = jax.jit(
+        lambda p, f, m, r: fused_decode(
+            model, p, f, m, r, num_rollouts=2, temperature=0.6,
+            decode_stride=4, compact=True,
+        )
+    )(params, feats, masks, rng)
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _check_pad_after_eos(got[0])
+    _check_pad_after_eos(got[2])
+
+
+def test_decode_stride_config_validation():
+    with pytest.raises(ValueError, match="decode_stride"):
+        ModelConfig(decode_stride=0)

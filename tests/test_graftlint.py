@@ -669,11 +669,11 @@ def test_cli_write_baseline_then_clean(tmp_path, capsys):
     assert cli_main([str(path), "--root", str(tmp_path)]) == 0
 
 
-def test_cli_list_rules_names_all_ten(tmp_path, capsys):
+def test_cli_list_rules_names_all_eleven(tmp_path, capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008", "GL009", "GL010"):
+                "GL007", "GL008", "GL009", "GL010", "GL011"):
         assert rid in out
 
 
@@ -689,6 +689,99 @@ def test_parse_error_is_reported_not_fatal(tmp_path):
     result = lint_paths([str(path)], str(tmp_path))
     assert [f.rule for f in result.findings] == ["GL000"]
     assert result.gating  # syntax errors gate
+
+
+# ---- GL011: scan-carry dtype drift ------------------------------------------
+
+def test_gl011_positive_scan_carry_cast_drift(tmp_path):
+    """A scan body that casts the carry to a dtype different from its
+    literal init — the stride-carry hazard this rule exists for."""
+    findings = _lint(tmp_path, "mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer(xs):\n"
+        "    def body(c, x):\n"
+        "        return (c + x).astype(jnp.bfloat16), x\n"
+        "    init = jnp.zeros((4,), jnp.float32)\n"
+        "    return jax.lax.scan(body, init, xs)\n"
+    ), rules=["GL011"])
+    assert _rules_of(findings) == ["GL011"]
+    assert findings[0].severity == "error"
+    assert "bfloat16" in findings[0].message and "float32" in findings[0].message
+
+
+def test_gl011_positive_while_loop_ctor_drift(tmp_path):
+    """while_loop body rebuilding the carry in a different dtype than the
+    (default-f32) init."""
+    findings = _lint(tmp_path, "mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer(n):\n"
+        "    def body(c):\n"
+        "        return jnp.asarray(c + 1, dtype=jnp.int32)\n"
+        "    return jax.lax.while_loop(lambda c: c < n, body, jnp.zeros(()))\n"
+    ), rules=["GL011"])
+    assert _rules_of(findings) == ["GL011"]
+
+
+def test_gl011_positive_tuple_carry_positional(tmp_path):
+    """Tuple carries compare leaf-by-leaf: only the drifting position
+    fires, dtype-matching ones stay quiet."""
+    findings = _lint(tmp_path, "mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer(xs):\n"
+        "    def body(c, x):\n"
+        "        a, b = c\n"
+        "        return (a.astype(jnp.float32), b.astype(jnp.float16)), x\n"
+        "    init = (jnp.zeros((2,), jnp.float32), jnp.zeros((2,), jnp.float32))\n"
+        "    return jax.lax.scan(body, init, xs)\n"
+    ), rules=["GL011"])
+    assert len(findings) == 1 and findings[0].rule == "GL011"
+    assert "float16" in findings[0].message
+
+
+def test_gl011_negative_matching_dtype(tmp_path):
+    findings = _lint(tmp_path, "mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer(xs):\n"
+        "    def body(c, x):\n"
+        "        return (c + x).astype(jnp.float32), x\n"
+        "    init = jnp.zeros((4,), jnp.float32)\n"
+        "    return jax.lax.scan(body, init, xs)\n"
+    ), rules=["GL011"])
+    assert findings == []
+
+
+def test_gl011_negative_unknown_dtypes_stay_quiet(tmp_path):
+    """No literal dtype on either side -> out of scope, no guessing (the
+    repo's tree.map-built carries must never false-positive)."""
+    findings = _lint(tmp_path, "mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer(xs, init):\n"
+        "    def body(c, x):\n"
+        "        return jax.tree.map(jnp.add, c, x), None\n"
+        "    return jax.lax.scan(body, init, xs)\n"
+    ), rules=["GL011"])
+    assert findings == []
+
+
+def test_gl011_negative_nested_def_returns_ignored(tmp_path):
+    """Returns inside helpers nested in the body are not the body's carry."""
+    findings = _lint(tmp_path, "mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def outer(xs):\n"
+        "    def body(c, x):\n"
+        "        def helper(v):\n"
+        "            return v.astype(jnp.bfloat16)\n"
+        "        return c + helper(x).astype(jnp.float32), x\n"
+        "    init = jnp.zeros((4,), jnp.float32)\n"
+        "    return jax.lax.scan(body, init, xs)\n"
+    ), rules=["GL011"])
+    assert findings == []
 
 
 # ---- tier-1 self-check: the repo itself stays lint-clean --------------------

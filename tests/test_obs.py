@@ -590,3 +590,135 @@ def test_trainer_epoch_events_report_meter_latency(chaos_datasets, tmp_path):
     assert xe["steps"] == 3.0 - 1.0  # first (compile) step excluded
     assert xe["clips_per_sec"] > 0 and rl["clips_per_sec"] > 0
     assert np.isfinite(xe["step_seconds_p95"])
+
+
+def test_report_decode_compaction_counters():
+    """The decode section surfaces the rl.decode.compaction counter pair
+    (lanes stepped vs compacted away) and the renderer prints the ledger."""
+    events = [
+        {"ts": 0.0, "event": "run_start", "run": "comp", "thread": "main"},
+        {
+            "ts": 1.0, "event": "metrics",
+            "counters": {
+                "rl.decode.compaction.lanes_stepped": 300.0,
+                "rl.decode.compaction.lanes_skipped": 100.0,
+            },
+            "gauges": {"rl.decode.budget": 30.0},
+            "histograms": {
+                "rl.decode.depth": {
+                    "buckets": [10.0, 20.0, 30.0],
+                    "counts": [0, 1, 0, 0],
+                    "sum": 15.0, "count": 1, "max": 15.0,
+                },
+            },
+        },
+        {"ts": 2.0, "event": "run_end", "run": "comp"},
+    ]
+    rep = build_report(events)
+    d = rep["decode"]
+    assert d["lanes_stepped"] == 300.0 and d["lanes_skipped"] == 100.0
+    assert d["compaction_saved_frac"] == pytest.approx(0.25)
+    text = render_report(rep)
+    assert "decode compaction" in text and "25.0% of lane-steps" in text
+
+
+def test_scst_records_compaction_counters(tmp_path):
+    """With a recorder installed, an SCST step feeds the depth histogram
+    AND the compaction counter pair from the decoded tokens (the default
+    decode compacts, so both counters exist and sum to G*B*depth)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from cst_captioning_tpu.config.config import (
+        ModelConfig, RLConfig, TrainConfig,
+    )
+    from cst_captioning_tpu.models import CaptionModel
+    from cst_captioning_tpu.rl import SCSTTrainer
+    from cst_captioning_tpu.train import create_train_state, make_optimizer
+
+    obs.REGISTRY.reset()
+    obs.configure(str(tmp_path / "obs"), run="comp")
+    try:
+        cfg = ModelConfig(
+            vocab_size=20, modalities=(("resnet", 6),), d_embed=8,
+            d_hidden=8, d_att=4, encoder="meanpool", dropout=0.0, max_len=6,
+            max_frames=3, dtype="float32", decode_stride=2,
+        )
+        model = CaptionModel(cfg)
+        rng = _np.random.default_rng(0)
+        feats = {
+            "resnet": jnp.asarray(rng.normal(size=(4, 3, 6)), jnp.float32)
+        }
+        masks = {"resnet": jnp.ones((4, 3), jnp.float32)}
+        labels = jnp.asarray(rng.integers(4, 20, size=(4, 6)), jnp.int32)
+        tx = make_optimizer(TrainConfig(lr=1e-3, grad_clip=5.0), 10)
+        state = create_train_state(model, tx, (feats, masks, labels), seed=1)
+        reward = lambda vids, rows: _np.ones(  # noqa: E731
+            len(rows), _np.float32
+        )
+        scst = SCSTTrainer(
+            model, reward,
+            RLConfig(enabled=True, num_rollouts=2, baseline="greedy"),
+        )
+        state, _ = scst.train_step(
+            state, feats, masks, ["v0", "v1", "v2", "v3"], jax.random.key(0)
+        )
+        snap = obs.snapshot()
+        stepped = snap["counters"]["rl.decode.compaction.lanes_stepped"]
+        skipped = snap["counters"]["rl.decode.compaction.lanes_skipped"]
+        depth = snap["histograms"]["rl.decode.depth"]["sum"]
+        assert stepped > 0 and skipped >= 0
+        assert stepped + skipped == 3 * 4 * depth  # G * B * depth
+    finally:
+        obs.shutdown()
+        obs.REGISTRY.reset()
+
+
+def test_observe_device_memory_samples_all_local_devices(monkeypatch):
+    """Every local device lands in device<k>.* gauges; the legacy aggregate
+    device.* gauges carry the max (the HBM-headroom signal on a balanced
+    mesh; ROADMAP obs open item, closed PR 5)."""
+    import jax
+
+    from cst_captioning_tpu.obs import metrics as m
+
+    class FakeDev:
+        def __init__(self, i, used, peak):
+            self.id = i
+            self._s = {"bytes_in_use": used, "peak_bytes_in_use": peak,
+                       "bytes_limit": 100.0}
+
+        def memory_stats(self):
+            return self._s
+
+    reg = m.Registry()
+    monkeypatch.setattr(
+        jax, "local_devices", lambda: [FakeDev(0, 10.0, 30.0),
+                                       FakeDev(1, 20.0, 25.0)]
+    )
+    assert m.observe_device_memory(reg) is True
+    snap = reg.snapshot()["gauges"]
+    assert snap["device0.bytes_in_use"] == 10.0
+    assert snap["device1.bytes_in_use"] == 20.0
+    assert snap["device.bytes_in_use"] == 20.0        # max across devices
+    assert snap["device.peak_bytes_in_use"] == 30.0   # device 0's peak
+    assert snap["device1.peak_bytes_in_use"] == 25.0
+
+
+def test_observe_device_memory_statless_backend(monkeypatch):
+    """CPU-style backends (memory_stats() -> None) write nothing."""
+    import jax
+
+    from cst_captioning_tpu.obs import metrics as m
+
+    class NoStats:
+        id = 0
+
+        def memory_stats(self):
+            return None
+
+    reg = m.Registry()
+    monkeypatch.setattr(jax, "local_devices", lambda: [NoStats()])
+    assert m.observe_device_memory(reg) is False
+    assert reg.snapshot()["gauges"] == {}
