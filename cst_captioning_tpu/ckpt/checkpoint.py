@@ -17,11 +17,12 @@ replace window and a post-"success" torn write have a fallback generation.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import re
 import shutil
-from typing import Any, Callable
+from typing import Any, Callable, Mapping
 
 import jax
 from flax import serialization
@@ -66,8 +67,14 @@ def _data_to_keys(loaded, template):
 
 
 def save_state(ckpt_dir: str, name: str, state: TrainState,
-               infos: dict[str, Any] | None = None) -> str:
+               infos: dict[str, Any] | None = None,
+               extra_files: Mapping[str, bytes] | None = None) -> str:
     """Durably write state+infos under ``ckpt_dir/name``; returns the path.
+
+    ``extra_files`` (name -> bytes) ride along in the same atomic swap and
+    are covered by the manifest — the drain-aware RL seam (``seam.npz``)
+    uses this so the seam tokens can never outlive or predate the state
+    they belong to.
 
     CONTRACT: one writer per ``ckpt_dir`` at a time — crash-atomic (a kill
     mid-save leaves the previous generation intact: only the stale ``.tmp``
@@ -87,7 +94,13 @@ def save_state(ckpt_dir: str, name: str, state: TrainState,
     write_bytes_durable(os.path.join(tmp, STATE_FILE), state_bytes)
     chaos.visit("ckpt.state_written")
     write_bytes_durable(os.path.join(tmp, INFOS_FILE), infos_bytes)
-    write_manifest(tmp, {STATE_FILE: state_bytes, INFOS_FILE: infos_bytes})
+    blobs = {STATE_FILE: state_bytes, INFOS_FILE: infos_bytes}
+    for extra_name, blob in (extra_files or {}).items():
+        if extra_name in blobs or os.sep in extra_name:
+            raise ValueError(f"bad extra checkpoint file name {extra_name!r}")
+        write_bytes_durable(os.path.join(tmp, extra_name), blob)
+        blobs[extra_name] = blob
+    write_manifest(tmp, blobs)
     fsync_dir(tmp)
     chaos.visit("ckpt.pre_replace")
     if os.path.exists(final):
@@ -168,16 +181,59 @@ class CheckpointManager:
             return True
         return value > self.best_value if self.mode == "max" else value < self.best_value
 
-    def _save(self, name: str, state: TrainState, infos: dict) -> str:
-        """One durable save with jittered-backoff retries on transient I/O."""
+    def _save(self, name: str, state: TrainState, infos: dict,
+              extra_files: Mapping[str, bytes] | None = None) -> str:
+        """One durable save with jittered-backoff retries on transient I/O.
+
+        ENOSPC gets a reclaim step before each retry: the oldest ``step_*``
+        generation (then any demoted ``*.prev``) is deleted — a full disk
+        costs the oldest history, never the run — with a structured
+        ``ckpt_enospc`` event + ``resilience.ckpt_enospc`` counter."""
+
+        def attempt():
+            try:
+                return save_state(
+                    self.ckpt_dir, name, state, infos,
+                    extra_files=extra_files,
+                )
+            except OSError as e:
+                if getattr(e, "errno", None) == errno.ENOSPC:
+                    freed = self._reclaim_space(exclude=name)
+                    obs.counter("resilience.ckpt_enospc").inc()
+                    self.log(
+                        "ckpt_enospc", name=name, freed=freed, detail=str(e),
+                    )
+                raise
+
         # the span covers retries + backoff sleeps: its dur IS the stall a
         # save inflicts on the step loop (the "ckpt" phase of the report)
         with obs.span("ckpt.save", ckpt=name):
             return retry_call(
-                save_state, self.ckpt_dir, name, state, infos,
+                attempt,
                 policy=self.retry,
                 on_retry=lambda info: self.log("ckpt_retry", name=name, **info),
             )
+
+    def _reclaim_space(self, exclude: str = "") -> list[str]:
+        """Free checkpoint-dir space for an ENOSPC retry: oldest ``step_*``
+        generation first, demoted ``*.prev`` generations next. Never touches
+        ``best``/``latest`` or the checkpoint being written."""
+        victims: list[str] = []
+        for _, step_name in self.step_checkpoints():
+            if step_name != exclude:
+                victims.append(step_name)
+                break
+        if not victims:
+            victims = sorted(
+                e for e in os.listdir(self.ckpt_dir)
+                if e.endswith(".prev") and e != f"{exclude}.prev"
+                and os.path.isdir(os.path.join(self.ckpt_dir, e))
+            )[:1]
+        for victim in victims:
+            shutil.rmtree(
+                os.path.join(self.ckpt_dir, victim), ignore_errors=True
+            )
+        return victims
 
     def save(self, state: TrainState, value: float | None = None,
              infos: dict | None = None) -> bool:
@@ -198,12 +254,15 @@ class CheckpointManager:
         return improved
 
     def save_step(self, state: TrainState, step: int,
-                  infos: dict | None = None) -> str:
+                  infos: dict | None = None,
+                  extra_files: Mapping[str, bytes] | None = None) -> str:
         """Mid-epoch ``step_<n>`` checkpoint + keep-last-``keep`` rotation."""
         infos = dict(infos or {})
         infos.setdefault("global_step", int(step))
         infos["best_value"] = self.best_value
-        path = self._save(f"step_{int(step):08d}", state, infos)
+        path = self._save(
+            f"step_{int(step):08d}", state, infos, extra_files=extra_files
+        )
         if self.keep > 0:
             for _, name in self.step_checkpoints()[:-self.keep]:
                 shutil.rmtree(
@@ -254,7 +313,11 @@ class CheckpointManager:
         with obs.span("ckpt.restore"):
             for name in self._candidates():
                 try:
-                    return load_state(self.ckpt_dir, name, template)
+                    state, infos = load_state(self.ckpt_dir, name, template)
+                    # which candidate won matters to the caller (sidecar
+                    # files like the RL seam live next to the state)
+                    infos.setdefault("ckpt_name", name)
+                    return state, infos
                 except Exception as e:
                     obs.counter("resilience.ckpt_corrupt").inc()
                     self.log(

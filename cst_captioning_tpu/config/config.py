@@ -188,6 +188,22 @@ class TrainConfig:
                 f"(got {self.ckpt_every_steps}, {self.keep_ckpts}, "
                 f"{self.max_rollbacks})"
             )
+        if self.elastic not in ("strict", "degraded"):
+            raise ValueError(
+                f"unknown elastic mode {self.elastic!r} "
+                "(expected 'strict' or 'degraded')"
+            )
+        if self.health_interval_s <= 0 or self.peer_timeout_s <= 0:
+            raise ValueError(
+                "health knobs out of range: health_interval_s > 0 and "
+                f"peer_timeout_s > 0 required (got {self.health_interval_s}, "
+                f"{self.peer_timeout_s})"
+            )
+        if self.health_sim_hosts < 0:
+            raise ValueError(
+                f"health_sim_hosts {self.health_sim_hosts} must be >= 0 "
+                "(0 = the real process count)"
+            )
     # per-step JSONL events (loss/reward + grad_norm every N steps; 0 = off,
     # keeping logs to per-epoch summaries)
     log_every_steps: int = 0
@@ -216,6 +232,27 @@ class TrainConfig:
     # window); 0 = NaN/inf detection only
     spike_factor: float = 0.0
     max_rollbacks: int = 2              # rollback budget per run before aborting
+    # ---- elastic multi-host resilience (resilience/health.py; README
+    # "Elastic training"): off by default — the hot loops then carry zero
+    # extra work (the peer-loss poll is gated on `health`)
+    health: bool = False                # run the heartbeat/watchdog monitor
+    health_dir: str = ""                # heartbeat dir ("" = <ckpt_dir>/health)
+    health_interval_s: float = 0.5      # watchdog beat/poll cadence
+    peer_timeout_s: float = 5.0         # heartbeat staleness before a strike
+    # consecutive stale polls (the debounce) before a peer is declared lost
+    health_misses: int = 2
+    # chaos/test only: pretend the cluster has N hosts (this process is host
+    # 0, the phantoms die only via the partial_preempt fault); 0 = the real
+    # jax.process_count()
+    health_sim_hosts: int = 0
+    # on peer loss after the drain+save: "strict" aborts (raise PeerLost;
+    # the restarted full-mesh run resumes bit-exactly) | "degraded"
+    # rendezvous the survivors, rebuild a shrunk data mesh, reshard from the
+    # drained checkpoint, and continue with per-host batch rescaling
+    elastic: str = "strict"
+    # a cross-host collective slower than this emits a dcn_stall event +
+    # counter (the DCN-stall span around the multihost barrier/broadcast)
+    dcn_stall_s: float = 2.0
 
 
 @dataclass(frozen=True)

@@ -18,6 +18,17 @@ from typing import Any, Callable, Iterable, Iterator
 import jax
 
 from cst_captioning_tpu import obs
+from cst_captioning_tpu.resilience import chaos
+from cst_captioning_tpu.resilience.chaos import TransientIOError
+from cst_captioning_tpu.resilience.retry import RetryPolicy, retry_call
+
+# transient H2D transfer failures (a torn DMA / chaos partial_h2d) are
+# redone in place under a tight budget: the staged numpy batch is still on
+# host, so re-placing it is always safe. Anything non-transient propagates.
+_H2D_RETRY = RetryPolicy(
+    max_attempts=3, base_delay=0.01, max_delay=0.1, budget=1.0,
+    retry_on=(TransientIOError,),
+)
 
 
 def prefetch_to_device(
@@ -27,6 +38,7 @@ def prefetch_to_device(
     transform: Callable[[Any], Any] | None = None,
     place: bool = True,
     stop_event: threading.Event | None = None,
+    stall_warn_s: float = 5.0,
 ) -> Iterator[Any]:
     """Iterate ``it``, staging ``size`` elements ahead onto device.
 
@@ -42,6 +54,13 @@ def prefetch_to_device(
     collate/transfer once set — the preemption path: when SIGTERM lands, the
     grace window should go to the checkpoint fsync, not to prefetching
     batches that will never run. Items already staged are still yielded.
+
+    ``stall_warn_s``: when the consumer waits longer than this on an empty
+    queue while the worker is still alive (a wedged prefetch thread, a
+    stalled filesystem read), a structured ``prefetch_stall`` event and the
+    ``resilience.prefetch_stall`` counter fire once per stall episode —
+    starvation becomes diagnosable instead of looking like slow compute.
+    The consumer keeps waiting (the worker may unwedge); 0 disables.
     """
     if not place:
         _place = lambda x: x
@@ -58,11 +77,26 @@ def prefetch_to_device(
     staged = obs.counter("prefetch.batches")
     depth = obs.gauge("prefetch.queue_depth")
 
+    def _h2d(x):
+        def put():
+            chaos.visit("prefetch.h2d")
+            return _place(x)
+
+        return retry_call(
+            put,
+            policy=_H2D_RETRY,
+            on_retry=lambda info: (
+                obs.counter("resilience.h2d_retry").inc(),
+                obs.event("h2d_retry", **info),
+            ),
+        )
+
     def _stage(x):
         t0 = time.perf_counter()
         with obs.span("prefetch.stage"):
+            x = chaos.visit("prefetch.stage", x)
             x = transform(x) if transform is not None else x
-            x = _place(x)
+            x = _h2d(x)
         stage_hist.observe(time.perf_counter() - t0)
         staged.inc()
         return x
@@ -101,11 +135,33 @@ def prefetch_to_device(
         finally:
             _put(_END)
 
+    def _get_with_stall_watchdog():
+        """q.get that reports (once per episode) when the worker starves the
+        step loop past ``stall_warn_s`` — the wedged-prefetch signature."""
+        if stall_warn_s <= 0:
+            return q.get()
+        reported = False
+        waited = 0.0
+        while True:
+            try:
+                return q.get(timeout=stall_warn_s)
+            except queue.Empty:
+                waited += stall_warn_s
+                if not reported:
+                    reported = True
+                    obs.counter("resilience.prefetch_stall").inc()
+                    obs.event(
+                        "prefetch_stall",
+                        waited_s=round(waited, 3),
+                        queue_depth=q.qsize(),
+                        worker_alive=t.is_alive(),
+                    )
+
     t = threading.Thread(target=worker, daemon=True, name="prefetch")
     t.start()
     try:
         while True:
-            x = q.get()
+            x = _get_with_stall_watchdog()
             # depth as the CONSUMER sees it post-get: 0 here while the
             # worker is mid-stage means the step loop is input-bound
             depth.set(q.qsize())

@@ -41,15 +41,21 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any, Iterable
 
 EVENTS_FILE = "events.jsonl"
+
+# per-process sub-streams of a multi-host run: process 0 writes the run dir
+# itself, processes k>0 write proc<k>/ underneath it (Trainer obs wiring)
+_PROC_DIR_RE = re.compile(r"^proc(\d+)$")
 
 # canonical phase order for the table; unknown names sort after, by total
 _PHASE_ORDER = (
     "setup", "xe.epoch", "xe.step", "rl.epoch", "rl.decode", "rl.reward",
     "rl.update", "eval", "eval.score", "ckpt", "ckpt.save", "ckpt.restore",
-    "prefetch.stage", "profile.window",
+    "dcn.collective", "degraded_rendezvous", "prefetch.stage",
+    "profile.window",
 )
 
 
@@ -232,6 +238,13 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
         "retry_attempts": counters.get("resilience.retry.attempt", 0),
         "retry_give_ups": counters.get("resilience.retry.give_up", 0),
         "ckpt_corrupt_fallbacks": counters.get("resilience.ckpt_corrupt", 0),
+        "ckpt_enospc": counters.get("resilience.ckpt_enospc", 0),
+        "prefetch_stalls": counters.get("resilience.prefetch_stall", 0),
+        "h2d_retries": counters.get("resilience.h2d_retry", 0),
+        "peer_loss_drains": counters.get("resilience.peer_loss_drain", 0),
+        "degraded_continuations": counters.get(
+            "resilience.degraded_continuation", 0
+        ),
         "chaos_faults": counters.get("resilience.chaos_fault", 0),
         "chaos_faults_by_kind": {
             k.rsplit(".", 1)[1]: v
@@ -239,6 +252,28 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
             if k.startswith("resilience.chaos_fault.")
         },
     }
+
+    # elastic-health summary (resilience/health.py): heartbeat gauges + the
+    # DCN-stall probe around cross-host collectives. None when the run never
+    # produced a health signal (monitor off, single-host, no collectives).
+    dcn = histograms.get("dcn.collective_seconds")
+    health = None
+    if any((
+        counters.get("health.heartbeats"), counters.get("health.dcn_stall"),
+        counters.get("health.peer_lost"), dcn and dcn.get("count"),
+        "health.peers_alive" in gauges,
+    )):
+        health = {
+            "heartbeats": counters.get("health.heartbeats", 0),
+            "peers_alive": gauges.get("health.peers_alive"),
+            "peer_age_max_s": gauges.get("health.peer_age_max_s"),
+            "peer_losses": counters.get("health.peer_lost", 0),
+            "dcn_stalls": counters.get("health.dcn_stall", 0),
+            "collectives": dcn.get("count", 0) if dcn else 0,
+            "collective_p95_s": (
+                _hist_quantile(dcn, 0.95) if dcn and dcn.get("count") else 0.0
+            ),
+        }
 
     return {
         "run": run,
@@ -250,11 +285,16 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
         "overlap": overlap_rows,
         "decode": decode,
         "resilience": resilience,
+        "health": health,
         "compile": {
             "count": counters.get("jit.compiles", 0),
             "seconds": counters.get("jit.compile_seconds", 0.0),
         },
         "profiler_windows": profiler_windows,
+        # absolute run window (wall-clock): feeds the cross-process skew
+        # attribution when per-proc streams are merged
+        "t_start": t_start if t_start is not None else t_first,
+        "t_end": t_end if t_end is not None else t_last,
         "events": len(events),
     }
 
@@ -340,15 +380,134 @@ def render_report(report: dict[str, Any]) -> str:
         f"{int(r['retry_give_ups'])} give-up(s)   ckpt-corrupt fallbacks: "
         f"{int(r['ckpt_corrupt_fallbacks'])}"
     )
+    elastic_bits = []
+    for key, label in (
+        ("peer_loss_drains", "peer-loss drains"),
+        ("degraded_continuations", "degraded continuations"),
+        ("ckpt_enospc", "ckpt ENOSPC reclaims"),
+        ("prefetch_stalls", "prefetch stalls"),
+        ("h2d_retries", "h2d retries"),
+    ):
+        if r.get(key):
+            elastic_bits.append(f"{label}: {int(r[key])}")
+    if elastic_bits:
+        lines.append("  " + "   ".join(elastic_bits))
     by_kind = r["chaos_faults_by_kind"]
     kinds = (
         " (" + ", ".join(f"{k}={int(v)}" for k, v in sorted(by_kind.items()))
         + ")" if by_kind else ""
     )
     lines.append(f"  chaos faults injected: {int(r['chaos_faults'])}{kinds}")
+    h = report.get("health")
+    if h:
+        lines.append("")
+        alive = h.get("peers_alive")
+        lines.append(
+            "health: "
+            f"{int(h['heartbeats'])} heartbeat(s)"
+            + (f", {int(alive)} peer(s) alive" if alive is not None else "")
+            + f", {int(h['peer_losses'])} peer loss(es); "
+            f"dcn: {int(h['collectives'])} collective(s), "
+            f"p95 {h['collective_p95_s']:.3f}s, "
+            f"{int(h['dcn_stalls'])} stall(s)"
+        )
+    if report.get("hosts"):
+        c = report["cluster"]
+        lines.append("")
+        lines.append(
+            f"cluster: {c['processes']} process streams merged — max end "
+            f"skew {c['max_end_skew_s']:.3f}s (straggler: proc"
+            f"{c['straggler_proc']}); totals: {int(c['chaos_faults'])} chaos "
+            f"fault(s), {int(c['dcn_stalls'])} dcn stall(s), "
+            f"{int(c['peer_losses'])} peer loss(es)"
+        )
+        hdr2 = (f"{'proc':>5} {'events':>7} {'wall_s':>8} {'start+':>8} "
+                f"{'end+':>8} {'top phase':<16} {'self_s':>8}")
+        lines.append(hdr2)
+        lines.append("-" * len(hdr2))
+        for host in report["hosts"]:
+            lines.append(
+                f"{host['proc']:>5} {host['events']:>7} "
+                f"{_fmt_s(host['wall_s'])} {_fmt_s(host['start_skew_s'])} "
+                f"{_fmt_s(host['end_skew_s'])} {host['top_phase']:<16} "
+                f"{_fmt_s(host['top_phase_self_s'])}"
+            )
     return "\n".join(lines)
 
 
+def _merge_proc_reports(report: dict[str, Any],
+                        procs: list[tuple[int, dict[str, Any]]]) -> None:
+    """Fold per-process sub-reports into the primary report: a ``hosts``
+    table with per-host skew attribution (who started late, who finished
+    last, where that host's time went) and cluster-total resilience/health
+    counts. ``procs`` includes process 0 (the primary stream)."""
+    ends = [r["t_end"] for _, r in procs if r["t_end"] is not None]
+    starts = [r["t_start"] for _, r in procs if r["t_start"] is not None]
+    t0 = min(starts) if starts else None
+    t_end_min = min(ends) if ends else None
+    hosts = []
+    for proc, rep in procs:
+        top_phase, top_self = "", 0.0
+        for p in rep["phases"]:
+            if p["self_s"] > top_self:
+                top_phase, top_self = p["phase"], p["self_s"]
+        hosts.append({
+            "proc": proc,
+            "events": rep["events"],
+            "wall_s": rep["wall_s"],
+            "complete": rep["complete"],
+            # skew attribution: how late this host started, and how long
+            # the earliest-finishing host would have waited on it at the
+            # final barrier — the per-host "who is the straggler" answer
+            "start_skew_s": (
+                rep["t_start"] - t0
+                if t0 is not None and rep["t_start"] is not None else 0.0
+            ),
+            "end_skew_s": (
+                rep["t_end"] - t_end_min
+                if t_end_min is not None and rep["t_end"] is not None
+                else 0.0
+            ),
+            "top_phase": top_phase,
+            "top_phase_self_s": top_self,
+            "chaos_faults": rep["resilience"]["chaos_faults"],
+            "dcn_stalls": (rep.get("health") or {}).get("dcn_stalls", 0),
+        })
+    straggler = max(hosts, key=lambda h: h["end_skew_s"])
+    report["hosts"] = hosts
+    report["cluster"] = {
+        "processes": len(hosts),
+        "max_end_skew_s": straggler["end_skew_s"],
+        "straggler_proc": straggler["proc"],
+        # cluster totals: per-process counters are per-host streams, so the
+        # cluster view is their SUM (the primary table stays process 0's)
+        "chaos_faults": sum(h["chaos_faults"] for h in hosts),
+        "dcn_stalls": sum(h["dcn_stalls"] for h in hosts),
+        "peer_losses": sum(
+            (r.get("health") or {}).get("peer_losses", 0) for _, r in procs
+        ),
+        "heartbeats": sum(
+            (r.get("health") or {}).get("heartbeats", 0) for _, r in procs
+        ),
+    }
+
+
 def report_run(run_dir: str) -> dict[str, Any]:
-    """Load + aggregate one run dir (the CLI's single entry point)."""
-    return build_report(load_events(run_dir))
+    """Load + aggregate one run dir (the CLI's single entry point).
+
+    Multi-host runs leave one stream per process (process 0 in ``run_dir``
+    itself, process k in ``run_dir/proc<k>/``); every stream is merged into
+    the ``hosts``/``cluster`` sections with per-host skew attribution."""
+    report = build_report(load_events(run_dir))
+    procs: list[tuple[int, dict[str, Any]]] = [(0, report)]
+    for entry in sorted(os.listdir(run_dir)):
+        m = _PROC_DIR_RE.match(entry)
+        if m and os.path.exists(os.path.join(run_dir, entry, EVENTS_FILE)):
+            procs.append((
+                int(m.group(1)),
+                build_report(load_events(os.path.join(run_dir, entry))),
+            ))
+    if len(procs) > 1:
+        procs.sort()
+        _merge_proc_reports(report, procs)
+    return report

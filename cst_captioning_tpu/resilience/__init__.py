@@ -20,12 +20,30 @@ makes each of them a *tested* code path:
   fallible I/O (checkpoint writes, the RL reward scorer).
 - :mod:`preempt`  — SIGTERM handling: set a flag, let the step loop save a
   mid-epoch checkpoint recording the exact batch index, and exit cleanly.
+- :mod:`health`   — elastic multi-host layer: per-host heartbeats + a
+  peer-loss watchdog (timeout/backoff), survivor rendezvous for the
+  degraded-mesh continuation, and the DCN-stall span around cross-host
+  collectives.
 - :mod:`chaos`    — seeded fault plans (NaN-poisoned batches, kill-mid-save,
-  transient I/O errors, slow/failing reward calls, preemption signals) driven
-  by the tests through named injection points compiled into the hot paths.
+  transient I/O errors, slow/failing reward calls, preemption signals,
+  partial preemption of one host, slow/partial H2D transfers, wedged
+  prefetch threads, ENOSPC mid-rotation) driven by the tests through named
+  injection points compiled into the hot paths.
 """
 
-from cst_captioning_tpu.resilience.chaos import Fault, FaultPlan, SimulatedKill
+from cst_captioning_tpu.resilience.chaos import (
+    Fault,
+    FaultPlan,
+    PartialTransferError,
+    SimulatedKill,
+)
+from cst_captioning_tpu.resilience.health import (
+    HealthMonitor,
+    PeerLost,
+    RendezvousTimeout,
+    collective_span,
+    rendezvous,
+)
 from cst_captioning_tpu.resilience.durable import (
     CorruptCheckpointError,
     verify_manifest,
@@ -45,13 +63,19 @@ __all__ = [
     "DivergenceSentinel",
     "Fault",
     "FaultPlan",
+    "HealthMonitor",
+    "PartialTransferError",
+    "PeerLost",
     "Preempted",
     "PreemptionHandler",
+    "RendezvousTimeout",
     "RetryPolicy",
     "RollbackRequested",
     "SimulatedKill",
     "TrainingDiverged",
+    "collective_span",
     "guarded_apply_gradients",
+    "rendezvous",
     "retry_call",
     "verify_manifest",
     "write_manifest",

@@ -20,6 +20,20 @@ Fault kinds:
 - ``"nan"``      — poison the visited payload (a ``data.batcher.Batch``):
   every feature array becomes NaN, so the forward pass diverges on device.
 - ``"slow"``     — ``time.sleep(delay)``, modelling a stalled reward service.
+- ``"slow_h2d"`` — ``time.sleep(delay)`` at the host->device staging point,
+  modelling a degraded PCIe/DMA transfer (fire at ``prefetch.h2d``).
+- ``"partial_h2d"`` — raise :class:`PartialTransferError` (a transient,
+  retryable transfer failure): the staged batch never fully landed in HBM.
+  The prefetch stage retries the placement under a small budget.
+- ``"wedged_prefetch"`` — ``time.sleep(delay)`` on the prefetch WORKER
+  thread (fire at ``prefetch.stage``): the staging thread wedges while the
+  consumer's stall watchdog detects and reports the starvation.
+- ``"enospc_rotation"`` — raise ``OSError(ENOSPC)``: the filesystem filled
+  up mid-checkpoint; rotation reclaims the oldest generation and retries.
+- ``"partial_preempt"`` — mark host ``host`` dead on the active
+  :class:`~cst_captioning_tpu.resilience.health.HealthMonitor` (tombstone +
+  synchronous loss flag): one host of the cluster was preempted while this
+  one survived — the elastic drain/degraded-continuation trigger.
 
 Injection points currently compiled in:
 
@@ -28,6 +42,8 @@ Injection points currently compiled in:
 ``xe.batch``       XE host batch prep, payload = the ``Batch`` (prefetch thread)
 ``rl.step``        RL train loop, once per completed step (main thread)
 ``rl.batch``       RL host batch prep, payload = the ``Batch`` (prefetch thread)
+``prefetch.stage`` prefetch worker, once per staged batch (worker thread)
+``prefetch.h2d``   inside the (retried) host->device placement of a batch
 ``ckpt.save``      entry of ``save_state`` (before any file is written)
 ``ckpt.state_written``  after ``state.msgpack`` hits the tmp dir
 ``ckpt.pre_replace``    tmp dir complete + fsync'd, final rename not yet done
@@ -37,11 +53,12 @@ Injection points currently compiled in:
 
 from __future__ import annotations
 
+import errno
 import os
 import signal
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any
 
 import numpy as np
@@ -58,6 +75,11 @@ class TransientIOError(OSError):
     """A chaos-injected transient I/O failure (retryable)."""
 
 
+class PartialTransferError(TransientIOError):
+    """A chaos-injected partial host->device transfer (retryable): the
+    destination buffer is torn, the placement must be redone."""
+
+
 @dataclass
 class Fault:
     """One scheduled fault.
@@ -65,16 +87,20 @@ class Fault:
     ``at`` is the 0-based visit index of ``point`` that triggers; pass
     ``("rand", lo, hi)`` to have :class:`FaultPlan` draw it from the plan
     seed (deterministic per seed). ``times`` widens io_error/nan/slow faults
-    to that many consecutive visits.
+    to that many consecutive visits. ``host`` names the victim host of a
+    ``partial_preempt``.
     """
 
     point: str
-    kind: str  # "kill" | "preempt" | "io_error" | "nan" | "slow"
+    kind: str  # see _KINDS / module docstring
     at: Any = 0
     times: int = 1
     delay: float = 0.0
+    host: int = 0
 
-    _KINDS = ("kill", "preempt", "io_error", "nan", "slow")
+    _KINDS = ("kill", "preempt", "io_error", "nan", "slow", "slow_h2d",
+              "partial_h2d", "wedged_prefetch", "enospc_rotation",
+              "partial_preempt")
 
     def __post_init__(self):
         if self.kind not in self._KINDS:
@@ -110,8 +136,7 @@ class FaultPlan:
                 tag, lo, hi = f.at
                 if tag != "rand":
                     raise ValueError(f"bad fault at-spec {f.at!r}")
-                f = Fault(f.point, f.kind, int(rng.integers(lo, hi)),
-                          f.times, f.delay)
+                f = replace(f, at=int(rng.integers(lo, hi)))
             self.faults.append(f)
         self.fired: list[dict] = []
         self._visits: dict[str, int] = {}
@@ -144,9 +169,25 @@ class FaultPlan:
                 raise SimulatedKill(f"chaos kill at {point}#{idx}")
             if f.kind == "io_error":
                 raise TransientIOError(f"chaos io_error at {point}#{idx}")
+            if f.kind == "partial_h2d":
+                raise PartialTransferError(
+                    f"chaos partial_h2d at {point}#{idx}"
+                )
+            if f.kind == "enospc_rotation":
+                raise OSError(
+                    errno.ENOSPC,
+                    f"chaos enospc at {point}#{idx}: No space left on device",
+                )
             if f.kind == "preempt":
                 os.kill(os.getpid(), signal.SIGTERM)
-            elif f.kind == "slow":
+            elif f.kind == "partial_preempt":
+                # lazy import: health is a consumer of chaos-adjacent obs
+                # plumbing; binding it at module import would cycle through
+                # the resilience package init
+                from cst_captioning_tpu.resilience import health
+
+                health.simulate_peer_loss(f.host)
+            elif f.kind in ("slow", "slow_h2d", "wedged_prefetch"):
                 time.sleep(f.delay)
             elif f.kind == "nan":
                 payload = _poison(payload)

@@ -1,0 +1,414 @@
+"""Cluster health: per-host heartbeats, a peer-loss watchdog, rendezvous.
+
+Multi-host training dies today if *any* host drops out: the collectives hang
+until a connect timeout and every survivor crashes. This module makes partial
+failure an *observable, recoverable* event:
+
+- :class:`HealthMonitor` — each host writes a tiny heartbeat file into a
+  shared directory on a watchdog thread (configurable interval) and watches
+  its peers' files. Liveness is stamped with the LOCAL monotonic clock at
+  *receipt* of a new heartbeat (never the peer's wall clock), so clock skew
+  between hosts cannot fake a death. A peer whose heartbeat goes stale past
+  ``timeout_s`` for ``misses`` consecutive polls (the debounce/backoff) — or
+  that left an explicit tombstone — is declared lost: a structured
+  ``peer_lost`` obs event fires and :attr:`HealthMonitor.peer_lost` flips,
+  which the train loops poll once per step (a plain Python bool read: no
+  device transfer, no syscall — GL001-clean by construction).
+- **Piggybacked liveness** — every completed cross-host collective proves all
+  peers were alive moments ago, so the multihost helpers call
+  :func:`record_collective` and refresh every peer's last-seen stamp for
+  free; the file heartbeat only has to cover the gaps between collectives.
+- :func:`rendezvous` — survivors agree on the new membership after a loss:
+  each writes a marker into a generation-numbered directory and polls (with
+  exponential backoff) until every expected host checked in or the timeout
+  expires. Deterministic and injectable (``clock``/``sleep``) for tests.
+- :func:`collective_span` — the DCN-stall probe: wraps a cross-host
+  barrier/broadcast in an obs span and emits a ``dcn_stall`` event + counter
+  when the collective exceeds the stall threshold, closing the "span around
+  the multihost barrier/broadcast" obs item.
+
+Everything here is host-side stdlib (no jax import): the monitor can run in
+tests, CLIs, and subprocesses without touching a backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Iterable
+
+from cst_captioning_tpu import obs
+
+
+class PeerLost(RuntimeError):
+    """Raised by a train loop after a peer-loss-triggered drain+save
+    completed. ``hosts`` names the lost host ids; the caller decides between
+    degraded-mesh continuation and the strict abort-and-full-restart."""
+
+    def __init__(self, hosts: Iterable[int], message: str):
+        self.hosts = sorted(int(h) for h in hosts)
+        super().__init__(message)
+
+
+class RendezvousTimeout(RuntimeError):
+    """A degraded-mesh rendezvous expired before every survivor checked in."""
+
+
+# default threshold for the DCN-stall probe; overridden per run from
+# train.dcn_stall_s via set_dcn_stall_threshold
+_DCN_STALL_S = 2.0
+
+
+def set_dcn_stall_threshold(seconds: float) -> None:
+    global _DCN_STALL_S
+    _DCN_STALL_S = float(seconds)
+
+
+@contextmanager
+def collective_span(op: str, stall_threshold_s: float | None = None):
+    """Span + stall probe around one cross-host collective.
+
+    Emits the ``dcn.collective`` span (op attribute), feeds the
+    ``dcn.collective_seconds`` histogram, and — when the collective took
+    longer than the stall threshold — a structured ``dcn_stall`` event plus
+    the ``health.dcn_stall`` counter. A completed collective also refreshes
+    every peer's liveness on the active monitor (piggybacked heartbeat)."""
+    t0 = time.perf_counter()
+    with obs.span("dcn.collective", op=op):
+        yield
+    dur = time.perf_counter() - t0
+    obs.histogram("dcn.collective_seconds").observe(dur)
+    threshold = _DCN_STALL_S if stall_threshold_s is None else stall_threshold_s
+    if dur > threshold:
+        obs.counter("health.dcn_stall").inc()
+        obs.event("dcn_stall", op=op, dur_s=round(dur, 6),
+                  threshold_s=threshold)
+    mon = _ACTIVE
+    if mon is not None:
+        mon.record_collective()
+
+
+class HealthMonitor:
+    """File-heartbeat cluster monitor with a watchdog thread.
+
+    One instance per process. ``num_hosts`` may exceed the real process count
+    (simulated hosts for chaos tests — this process is ``host_id`` and the
+    phantom peers are only ever killed via :meth:`simulate_loss`): a peer
+    that NEVER heartbeated is not declared dead by staleness alone, only a
+    peer that went silent after being seen, or one with a tombstone.
+
+    ``clock`` is injectable (defaults to ``time.monotonic``) so loss
+    detection is testable without sleeping through real timeouts.
+    """
+
+    def __init__(
+        self,
+        dir: str,
+        host_id: int = 0,
+        num_hosts: int = 1,
+        interval_s: float = 0.5,
+        timeout_s: float = 5.0,
+        misses: int = 2,
+        log: Callable[..., None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        start_thread: bool = True,
+    ):
+        if num_hosts < 1 or not 0 <= host_id < num_hosts:
+            raise ValueError(
+                f"host_id {host_id} not in [0, num_hosts={num_hosts})"
+            )
+        if interval_s <= 0 or timeout_s <= 0 or misses < 1:
+            raise ValueError(
+                "health knobs out of range: interval_s > 0, timeout_s > 0, "
+                f"misses >= 1 required (got {interval_s}, {timeout_s}, "
+                f"{misses})"
+            )
+        self.dir = dir
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.misses = misses
+        self.log = log or (lambda event, **fields: None)
+        self.clock = clock
+        self._start_thread = start_thread
+        self.peers: set[int] = set(range(num_hosts)) - {host_id}
+        self.lost_hosts: set[int] = set()
+        self._seq = 0
+        self._step = 0
+        self._seen_seq: dict[int, int] = {}
+        self._last_seen: dict[int, float] = {}
+        self._strikes: dict[int, int] = {}
+        self._loss_event = threading.Event()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        os.makedirs(dir, exist_ok=True)
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "HealthMonitor":
+        global _ACTIVE
+        now = self.clock()
+        with self._lock:
+            # grace period: peers have a full timeout from start to appear
+            for p in self.peers:
+                self._last_seen.setdefault(p, now)
+        # a restarted host announces itself alive: its own tombstone (left
+        # by the survivors of a previous incarnation) is stale by definition
+        try:
+            os.unlink(self._tombstone(self.host_id))
+        except FileNotFoundError:
+            pass
+        self.beat()
+        _ACTIVE = self
+        if self._start_thread and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._watchdog, daemon=True, name="health-watchdog"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        global _ACTIVE
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if _ACTIVE is self:
+            _ACTIVE = None
+
+    def __enter__(self) -> "HealthMonitor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _watchdog(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+            self.poll()
+
+    # ---- heartbeats ---------------------------------------------------------
+
+    def _hb_path(self, host: int) -> str:
+        return os.path.join(self.dir, f"host{host}.hb")
+
+    def _tombstone(self, host: int) -> str:
+        return os.path.join(self.dir, f"host{host}.dead")
+
+    def note_step(self, step: int) -> None:
+        """Record train progress for the next heartbeat payload. A plain
+        attribute store — safe (and free) once per step in the hot loop."""
+        self._step = int(step)
+
+    def beat(self, step: int | None = None) -> None:
+        """Write this host's heartbeat file (atomic replace)."""
+        if step is not None:
+            self._step = int(step)
+        with self._lock:
+            self._seq += 1
+            rec = {"host": self.host_id, "seq": self._seq,
+                   "step": self._step, "ts": time.time()}  # graftlint: disable=GL010 (heartbeat wall-clock payload, read by humans/other hosts)
+        path = self._hb_path(self.host_id)
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(rec, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            # a missed beat is survivable (peers debounce); losing the run
+            # to a transient shared-fs error is not
+            self.log("heartbeat_write_failed", error=type(e).__name__,
+                     detail=str(e))
+            return
+        obs.counter("health.heartbeats").inc()
+
+    def _read_hb(self, host: int) -> dict | None:
+        try:
+            with open(self._hb_path(host), encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # absent / torn mid-replace: treated as "no news"
+
+    # ---- peer-loss detection ------------------------------------------------
+
+    def poll(self, now: float | None = None) -> list[int]:
+        """One watchdog pass: refresh last-seen stamps from peer heartbeat
+        files, detect tombstones and stale peers, update gauges. Returns the
+        hosts newly declared lost by this pass."""
+        now = self.clock() if now is None else now
+        newly_lost: list[int] = []
+        max_age = 0.0
+        with self._lock:
+            peers = sorted(self.peers - self.lost_hosts)
+        for p in peers:
+            if os.path.exists(self._tombstone(p)):
+                if self._mark_lost(p, reason="tombstone"):
+                    newly_lost.append(p)
+                continue
+            rec = self._read_hb(p)
+            with self._lock:
+                if rec is not None and rec.get("seq") != self._seen_seq.get(p):
+                    # NEW heartbeat: stamp receipt with OUR clock (clock skew
+                    # between hosts can never fake a death)
+                    self._seen_seq[p] = rec.get("seq")
+                    self._last_seen[p] = now
+                    self._strikes[p] = 0
+                    age = 0.0
+                else:
+                    age = now - self._last_seen.get(p, now)
+            max_age = max(max_age, age)
+            if age > self.timeout_s:
+                with self._lock:
+                    self._strikes[p] = self._strikes.get(p, 0) + 1
+                    strikes = self._strikes[p]
+                if strikes >= self.misses and self._seen_seq.get(p) is not None:
+                    if self._mark_lost(p, reason="heartbeat_timeout",
+                                       age_s=round(age, 3)):
+                        newly_lost.append(p)
+        obs.gauge("health.peers_alive").set(
+            float(len(self.survivors()) - 1)
+        )
+        obs.gauge("health.peer_age_max_s").set(max_age)
+        return newly_lost
+
+    def _mark_lost(self, host: int, **info) -> bool:
+        with self._lock:
+            if host in self.lost_hosts or host not in self.peers:
+                return False
+            self.lost_hosts.add(host)
+        obs.counter("health.peer_lost").inc()
+        obs.event("peer_lost", host=host, **info)
+        self.log("peer_lost", host=host, **info)
+        self._loss_event.set()
+        return True
+
+    def record_collective(self) -> None:
+        """A cross-host collective completed: every non-lost peer was alive
+        to participate — refresh all their last-seen stamps (the piggybacked
+        heartbeat)."""
+        now = self.clock()
+        with self._lock:
+            for p in self.peers - self.lost_hosts:
+                self._last_seen[p] = now
+                self._strikes[p] = 0
+
+    def simulate_loss(self, host: int) -> None:
+        """Chaos hook (``partial_preempt`` fault): kill a (possibly
+        simulated) peer NOW — tombstone on disk for other real processes,
+        synchronous mark for deterministic single-process tests."""
+        if host == self.host_id:
+            raise ValueError(
+                f"partial_preempt host {host} is this host; use the "
+                "'preempt' fault kind for whole-process preemption"
+            )
+        if host not in self.peers:
+            raise ValueError(
+                f"partial_preempt host {host} not a peer of host "
+                f"{self.host_id} (peers: {sorted(self.peers)})"
+            )
+        with open(self._tombstone(host), "w", encoding="utf-8") as f:
+            json.dump({"host": host, "by": self.host_id}, f)
+        self._mark_lost(host, reason="partial_preempt")
+
+    # ---- membership ---------------------------------------------------------
+
+    @property
+    def peer_lost(self) -> bool:
+        """True when at least one unacknowledged peer loss is pending. A
+        lock-free Event read — the once-per-step poll in the train loops."""
+        return self._loss_event.is_set()
+
+    def lost(self) -> list[int]:
+        with self._lock:
+            return sorted(self.lost_hosts)
+
+    def survivors(self) -> list[int]:
+        with self._lock:
+            return sorted(
+                ({self.host_id} | self.peers) - self.lost_hosts
+            )
+
+    def acknowledge(self) -> None:
+        """Clear the pending loss flag (the drain+continuation handled it);
+        the lost set stays recorded so a dead host is never re-admitted."""
+        self._loss_event.clear()
+
+    def set_membership(self, hosts: Iterable[int]) -> None:
+        """Adopt the post-rendezvous membership: only these hosts are peers
+        from now on (the lost record is kept for reporting)."""
+        hosts = set(int(h) for h in hosts)
+        with self._lock:
+            self.peers = hosts - {self.host_id}
+
+
+_ACTIVE: HealthMonitor | None = None
+
+
+def active_monitor() -> HealthMonitor | None:
+    return _ACTIVE
+
+
+def simulate_peer_loss(host: int) -> None:
+    """Module-level chaos entry point for the ``partial_preempt`` fault."""
+    mon = _ACTIVE
+    if mon is None:
+        raise RuntimeError(
+            "partial_preempt fault fired with no active HealthMonitor — "
+            "enable train.health (the fault models a peer loss the monitor "
+            "must detect)"
+        )
+    mon.simulate_loss(host)
+
+
+def rendezvous(
+    dir: str,
+    host_id: int,
+    hosts: Iterable[int],
+    generation: int = 0,
+    timeout_s: float = 30.0,
+    poll_s: float = 0.05,
+    backoff: float = 1.5,
+    max_poll_s: float = 1.0,
+    clock: Callable[[], float] = time.monotonic,
+    sleep: Callable[[float], None] = time.sleep,
+) -> list[int]:
+    """Survivor rendezvous: block until every host in ``hosts`` checked into
+    the generation directory, with exponential-backoff polling.
+
+    Each caller writes ``<dir>/rendezvous_<generation>/host<k>.json`` and
+    polls for the others. Returns the sorted membership on success; raises
+    :class:`RendezvousTimeout` naming the missing hosts otherwise (the
+    caller's strict fallback: abort and full-restart).
+    """
+    expected = sorted(int(h) for h in hosts)
+    rdir = os.path.join(dir, f"rendezvous_{int(generation):04d}")
+    os.makedirs(rdir, exist_ok=True)
+    own = os.path.join(rdir, f"host{host_id}.json")
+    tmp = f"{own}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"host": host_id, "ts": time.time()}, f)  # graftlint: disable=GL010 (rendezvous marker wall-clock payload)
+    os.replace(tmp, own)
+    t0 = clock()
+    delay = poll_s
+    while True:
+        present = [
+            h for h in expected
+            if os.path.exists(os.path.join(rdir, f"host{h}.json"))
+        ]
+        if len(present) == len(expected):
+            obs.event("rendezvous", generation=generation, hosts=present)
+            return present
+        if clock() - t0 > timeout_s:
+            missing = sorted(set(expected) - set(present))
+            raise RendezvousTimeout(
+                f"rendezvous generation {generation} timed out after "
+                f"{timeout_s}s: hosts {missing} never checked in "
+                f"(present: {present})"
+            )
+        sleep(delay)
+        delay = min(delay * backoff, max_poll_s)

@@ -6,6 +6,16 @@ half-updated trainer state); the step loop checks the flag once per step,
 saves a mid-epoch checkpoint recording the exact batch index, and raises
 :class:`Preempted` so drivers exit nonzero and the next run resumes the
 remainder of the epoch.
+
+The save itself runs in DRAIN-AWARE order (Trainer._preempt_save /
+SCSTTrainer.train_epoch): the pipelined RL loop first applies its in-flight
+updates in schedule order, then decodes the seam batch at its exact
+pipeline position and persists the tokens (``seam.npz``) inside the same
+atomic checkpoint swap — so a pipelined mid-epoch resume replays the seam
+instead of re-decoding it against params one update fresher, and both
+``rl.pipelined`` modes resume bit-identically. Partial preemption (one
+host of a multi-host cluster, detected by :mod:`resilience.health`) drains
+through the same path before :class:`~.health.PeerLost` unwinds.
 """
 
 from __future__ import annotations

@@ -676,10 +676,67 @@ class SCSTTrainer:
             state, greedy, samples, feats, masks, video_ids, valid_np
         )
 
+    # ---- drain-aware seam (pipelined preemption) ---------------------------
+
+    def _seam_capture(self, decoded_pair, video_ids) -> dict:
+        """Host copies of a decoded-but-unscored batch's tokens — the
+        rollout/update SEAM of the pipelined loop. Gathered globally so any
+        surviving process can replay them (single-process: plain asarray)."""
+        from cst_captioning_tpu.train import multihost
+
+        greedy, samples = decoded_pair
+        all_ids = [
+            i for sub in multihost.allgather_pyobj(list(video_ids))
+            for i in sub
+        ]
+        out = {
+            "samples": multihost.allgather_to_host(samples),
+            "video_ids": all_ids,
+        }
+        if greedy is not None:
+            out["greedy"] = multihost.allgather_to_host(greedy)
+        return out
+
+    def _seam_tokens_to_device(self, seam: dict):
+        """Persisted seam tokens -> device arrays in the decode's output
+        layout (greedy [B,T] over 'data', samples [K,B,T] over (None,'data'))
+        so the resumed pipeline is indistinguishable from a live decode."""
+        from cst_captioning_tpu.train import multihost
+
+        samples = np.asarray(seam["samples"])
+        greedy = seam.get("greedy")
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            samples = multihost.put_full_global(
+                NamedSharding(self.mesh, P(None, "data")), samples
+            )
+            if greedy is not None:
+                greedy = multihost.put_full_global(
+                    NamedSharding(self.mesh, P("data")), np.asarray(greedy)
+                )
+        else:
+            samples = jnp.asarray(samples)
+            if greedy is not None:
+                greedy = jnp.asarray(np.asarray(greedy))
+        return greedy, samples
+
+    @staticmethod
+    def _seam_matches(seam: dict, video_ids) -> bool:
+        from cst_captioning_tpu.train import multihost
+
+        ids = [
+            i for sub in multihost.allgather_pyobj(list(video_ids))
+            for i in sub
+        ]
+        return list(seam.get("video_ids", [])) == ids
+
     # ---- pipelined epoch ----------------------------------------------------
 
     def train_epoch(self, state: TrainState, batches, rng, on_step=None,
-                    pipelined: bool = True, should_stop=None):
+                    pipelined: bool = True, should_stop=None,
+                    seam: dict | None = None,
+                    seam_sink: dict | None = None):
         """SCST over an epoch of batches.
 
         ``should_stop()`` (optional) is polled once per batch; when it turns
@@ -687,6 +744,23 @@ class SCSTTrainer:
         every batch already decoded gets its update applied, so the returned
         state corresponds to exactly ``len(metrics)`` completed steps (the
         preemption-save path depends on this invariant).
+
+        ``seam_sink`` (pipelined only) opts into the DRAIN-AWARE stop order:
+        instead of discarding the batch fetched when ``should_stop`` fired,
+        the loop runs that iteration's schedule prefix — update(i-2) ->
+        decode(i) — captures the freshly decoded tokens into ``seam_sink``
+        (via :meth:`_seam_capture`), then scores+applies the final pending
+        batch. The caller persists the sink next to the checkpoint; a resume
+        that passes it back as ``seam`` replays those tokens for its first
+        batch instead of re-decoding — the decode then used params from the
+        exact pipeline schedule position, so a pipelined mid-epoch resume is
+        BIT-IDENTICAL to the uninterrupted run (previously the seam batch
+        was re-decoded against params one update fresher).
+
+        ``seam`` (pipelined only): tokens for the first batch, from a prior
+        ``seam_sink``. Ignored (with a live decode fallback) when the batch
+        identity check fails — a changed data order must never silently
+        marry old tokens to new features.
 
         ``batches`` yields ``(feats, masks, video_ids, valid)`` with arrays
         already on device.
@@ -736,25 +810,54 @@ class SCSTTrainer:
 
         scored = None     # _apply args: advantage ready, update not dispatched
         decoded = None    # _score args: decode dispatched, not yet scored
+        first = True
         for feats, masks, video_ids, valid in batches:
             if should_stop is not None and should_stop():
+                if seam_sink is not None:
+                    # drain-aware stop: run THIS iteration's schedule prefix
+                    # (update(i-2) then decode(i)) so the seam batch is
+                    # decoded against the params the uninterrupted pipeline
+                    # would have used, and capture its tokens for the
+                    # checkpoint instead of scoring it
+                    if scored is not None:
+                        state, m = self._apply(state, *scored)
+                        scored = None
+                        emit(m)
+                    rng, srng = jax.random.split(rng)
+                    with obs.span("rl.decode"):
+                        d = self.decode(state.params, feats, masks, srng)
+                    seam_sink.update(self._seam_capture(d, video_ids))
+                    if decoded is not None:
+                        state, m = self._apply(state, *self._score(*decoded))
+                        emit(m)
+                    decoded = None
                 break
             if scored is not None:
                 state, m = self._apply(state, *scored)
                 scored = None
                 emit(m)
             rng, srng = jax.random.split(rng)
-            with obs.span("rl.decode"):
-                d = self.decode(state.params, feats, masks, srng)
-                for arr in d:
-                    # start the device->host token transfer NOW, so it
-                    # overlaps this decode — by the time _score reads the
-                    # tokens they are already on host. greedy is None for the
-                    # scb/none baselines (no greedy rollout); multi-host
-                    # global arrays are not fully addressable here and their
-                    # reads go through to_host_local.
-                    if arr is not None and arr.is_fully_addressable:
-                        arr.copy_to_host_async()
+            if first and seam is not None and self._seam_matches(
+                seam, video_ids
+            ):
+                # resumed seam batch: replay the persisted tokens (decoded
+                # pre-preemption at this exact schedule position); the rng
+                # split above is still consumed so later batches' streams
+                # stay aligned with the uninterrupted run
+                d = self._seam_tokens_to_device(seam)
+            else:
+                with obs.span("rl.decode"):
+                    d = self.decode(state.params, feats, masks, srng)
+                    for arr in d:
+                        # start the device->host token transfer NOW, so it
+                        # overlaps this decode — by the time _score reads the
+                        # tokens they are already on host. greedy is None for
+                        # the scb/none baselines (no greedy rollout);
+                        # multi-host global arrays are not fully addressable
+                        # here and their reads go through to_host_local.
+                        if arr is not None and arr.is_fully_addressable:
+                            arr.copy_to_host_async()
+            first = False
             if decoded is not None:
                 # host scores batch i-1 while the device runs update(i-2) +
                 # decode(i) queued above

@@ -1091,3 +1091,120 @@ class ScanCarryDtypeRule(Rule):
             return node.value
         d = _last(_dotted(node))
         return d or None
+
+
+# ---- GL012: collective-axis-name typos --------------------------------------
+
+# collective -> positional index of its axis-name argument
+_GL012_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "pbroadcast": 1, "pcast": 1, "axis_index": 0,
+}
+_GL012_AXIS_KWARGS = ("axis_name",)
+
+# module-level cache: (root, mesh.py mtime) -> declared axis names
+_GL012_AXES_CACHE: dict = {}
+
+
+def _declared_mesh_axes(root: str) -> frozenset:
+    """Mesh axis names declared by ``train/mesh.py``: the string defaults of
+    every ``*axis``-named function parameter (``make_mesh(axis='data',
+    seq_axis='seq')`` is the declaration site). Falls back to the historical
+    ``{'data', 'seq'}`` when the file is missing or declares nothing."""
+    path = os.path.join(root, "cst_captioning_tpu", "train", "mesh.py")
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = None
+    key = (os.path.abspath(root), mtime)
+    cached = _GL012_AXES_CACHE.get(key)
+    if cached is not None:
+        return cached
+    axes: set[str] = set()
+    if mtime is not None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            tree = None
+        if tree is not None:
+            for node in ast.walk(tree):
+                if not isinstance(node, _FUNC_NODES):
+                    continue
+                args = node.args
+                pos = args.posonlyargs + args.args
+                pairs = list(
+                    zip(pos[len(pos) - len(args.defaults):], args.defaults)
+                ) + [
+                    (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                    if d is not None
+                ]
+                for arg, default in pairs:
+                    if arg.arg.endswith("axis") and isinstance(
+                        default, ast.Constant
+                    ) and isinstance(default.value, str) and default.value:
+                        axes.add(default.value)
+    out = frozenset(axes) if axes else frozenset({"data", "seq"})
+    _GL012_AXES_CACHE[key] = out
+    return out
+
+
+@register
+class CollectiveAxisRule(Rule):
+    id = "GL012"
+    name = "collective-axis-name-typo"
+    severity = "error"
+    rationale = (
+        "a psum/pmean/all_gather over a misspelled mesh axis name only "
+        "fails at trace time deep inside shard_map (unbound axis) — or, "
+        "with nested meshes, silently reduces over the WRONG axis; literal "
+        "axis names are checked against the axes train/mesh.py declares"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # package code only: tests/fixtures spell fake axes on purpose
+        return _in_package(ctx)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        allowed = _declared_mesh_axes(ctx.root)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last(_dotted(node.func))
+            pos = _GL012_COLLECTIVES.get(name)
+            if pos is None:
+                continue
+            axis_arg = None
+            for kw in node.keywords:
+                if kw.arg in _GL012_AXIS_KWARGS:
+                    axis_arg = kw.value
+            if axis_arg is None and len(node.args) > pos:
+                axis_arg = node.args[pos]
+            for axis in self._axis_literals(axis_arg):
+                if axis not in allowed:
+                    out.append(ctx.finding(
+                        self, node,
+                        f"{name}(...) over axis {axis!r}, which is not a "
+                        "mesh axis train/mesh.py declares "
+                        f"({', '.join(sorted(allowed))}): a typo here is an "
+                        "unbound-axis trace error at best and a wrong-axis "
+                        "reduction at worst",
+                    ))
+        return out
+
+    @staticmethod
+    def _axis_literals(node) -> list[str]:
+        """String-literal axis names in an axis argument (a constant or a
+        tuple/list of constants); dynamic expressions are out of scope."""
+        if node is None:
+            return []
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [
+                e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+        return []

@@ -37,6 +37,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cst_captioning_tpu.compat import distributed_is_initialized
+# DCN-stall probe (resilience/health.py): every cross-host barrier/broadcast
+# below runs inside collective_span — a dcn.collective span + histogram, a
+# structured dcn_stall event past the threshold, and a piggybacked liveness
+# refresh on the active HealthMonitor (a completed collective proves every
+# peer was alive). Single-process paths return before the span.
+from cst_captioning_tpu.resilience.health import collective_span
 
 # NOTE: jax.experimental.multihost_utils must NOT be imported at module
 # level: importing it initializes the XLA backend, after which a later
@@ -243,7 +249,8 @@ def allgather_to_host(arr) -> np.ndarray:
         return np.asarray(arr)
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    with collective_span("allgather_to_host"):
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
 def global_scalar_mean(x: float) -> float:
@@ -254,9 +261,12 @@ def global_scalar_mean(x: float) -> float:
         return float(x)
     from jax.experimental import multihost_utils
 
-    return float(
-        np.mean(multihost_utils.process_allgather(np.asarray(x, np.float64)))
-    )
+    with collective_span("global_scalar_mean"):
+        return float(
+            np.mean(
+                multihost_utils.process_allgather(np.asarray(x, np.float64))
+            )
+        )
 
 
 def allgather_pyobj(obj) -> list:
@@ -269,19 +279,20 @@ def allgather_pyobj(obj) -> list:
         return [obj]
     from jax.experimental import multihost_utils
 
-    data = np.frombuffer(
-        json.dumps(obj, default=float).encode("utf-8"), dtype=np.uint8
-    )
-    lengths = np.asarray(
-        multihost_utils.process_allgather(np.asarray(data.size, np.int64))
-    ).reshape(-1)
-    padded = np.zeros((int(lengths.max()),), np.uint8)
-    padded[: data.size] = data
-    rows = np.asarray(multihost_utils.process_allgather(padded))
-    return [
-        json.loads(rows[i, : int(lengths[i])].tobytes().decode("utf-8"))
-        for i in range(rows.shape[0])
-    ]
+    with collective_span("allgather_pyobj"):
+        data = np.frombuffer(
+            json.dumps(obj, default=float).encode("utf-8"), dtype=np.uint8
+        )
+        lengths = np.asarray(
+            multihost_utils.process_allgather(np.asarray(data.size, np.int64))
+        ).reshape(-1)
+        padded = np.zeros((int(lengths.max()),), np.uint8)
+        padded[: data.size] = data
+        rows = np.asarray(multihost_utils.process_allgather(padded))
+        return [
+            json.loads(rows[i, : int(lengths[i])].tobytes().decode("utf-8"))
+            for i in range(rows.shape[0])
+        ]
 
 
 def broadcast_pyobj(obj):
@@ -303,9 +314,10 @@ def global_weighted_mean(value_sum: float, weight: float) -> float:
     else:
         from jax.experimental import multihost_utils
 
-        pair = multihost_utils.process_allgather(
-            np.asarray([value_sum, weight], np.float64)
-        )
+        with collective_span("global_weighted_mean"):
+            pair = multihost_utils.process_allgather(
+                np.asarray([value_sum, weight], np.float64)
+            )
         total = np.sum(np.asarray(pair).reshape(-1, 2), axis=0)
         total_v, total_w = float(total[0]), float(total[1])
     return total_v / total_w if total_w > 0.0 else 0.0

@@ -11,15 +11,26 @@ step. Host batch prep overlaps device compute via the prefetch thread.
 Resilience (resilience/ package): both phase loops run under a SIGTERM
 preemption handler (mid-epoch save recording the exact batch index, so a
 resumed run replays the *remainder* of the epoch — the epoch-keyed shuffle
-makes that bit-deterministic), a divergence sentinel with a configurable
-policy (``train.on_divergence``), optional ``train.ckpt_every_steps``
-mid-epoch ``step_*`` checkpoints with keep-last-K rotation, and chaos
-injection points (``xe.step``/``xe.batch``/``rl.step``/``rl.batch``) so the
-fault paths are testable.
+makes that bit-deterministic; the pipelined RL drain additionally persists
+the seam batch's tokens so resume is bit-identical in both pipeline modes),
+a divergence sentinel with a configurable policy (``train.on_divergence``),
+optional ``train.ckpt_every_steps`` mid-epoch ``step_*`` checkpoints with
+keep-last-K rotation, and chaos injection points
+(``xe.step``/``xe.batch``/``rl.step``/``rl.batch``) so the fault paths are
+testable.
+
+Elastic multi-host resilience (``train.health``, README "Elastic
+training"): a heartbeat monitor + peer-loss watchdog
+(resilience/health.py) lets the loops detect a lost host, drain + save,
+and then either abort for a bit-exact full-mesh restart
+(``train.elastic='strict'``) or rendezvous the survivors, rebuild a shrunk
+data mesh, reshard optimizer state from the drained checkpoint, and keep
+training (``'degraded'``).
 """
 
 from __future__ import annotations
 
+import io
 import itertools
 import json
 import os
@@ -27,6 +38,7 @@ import threading
 
 import jax
 import numpy as np
+from jax.sharding import Mesh
 
 from cst_captioning_tpu import obs
 from cst_captioning_tpu.obs import flops as _flops
@@ -44,6 +56,8 @@ from cst_captioning_tpu.parallel import (
     sp_model,
 )
 from cst_captioning_tpu.resilience import chaos
+from cst_captioning_tpu.resilience import health as health_mod
+from cst_captioning_tpu.resilience.health import PeerLost
 from cst_captioning_tpu.resilience.preempt import Preempted, PreemptionHandler
 from cst_captioning_tpu.resilience.sentinel import (
     DivergenceSentinel,
@@ -68,8 +82,15 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     "train.log_every",  # pre-rename snapshots carry the old field name
     # resilience plumbing: save cadence/rotation/rollback budget change how a
     # run survives faults, not what it computes (on_divergence/spike_factor
-    # DO alter numerics under faults, so those two stay drift-tracked)
+    # DO alter numerics under faults, so those two stay drift-tracked;
+    # train.elastic also stays tracked — degraded vs strict changes what a
+    # faulted run computes)
     "train.ckpt_every_steps", "train.keep_ckpts", "train.max_rollbacks",
+    # elastic-health plumbing: where heartbeats go and how fast loss is
+    # detected, not what the run computes
+    "train.health", "train.health_dir", "train.health_interval_s",
+    "train.peer_timeout_s", "train.health_misses", "train.health_sim_hosts",
+    "train.dcn_stall_s",
     # observability plumbing: where the spans/metrics go, not what runs
     "train.obs", "train.obs_dir",
     "eval.results_json",
@@ -194,26 +215,7 @@ class Trainer:
         self.guard = cfg.train.on_divergence != "off"
         if self.mesh is not None:
             self.state = replicate(self.mesh, self.state)
-            if self.sp:
-                # SP params are layout-identical to the plain model's, so the
-                # state init above (plain model) feeds the SP step directly
-                # donate=True: the step consumes self.state (rebound on every
-                # call), so params + Adam moments update in place instead of
-                # double-buffering — HBM headroom on the production path
-                self.xe_step = make_sp_xe_step(
-                    sp_model(cfg.model), self.mesh, cfg.train.label_smoothing,
-                    data_axis="data", donate=True, guard=self.guard,
-                )
-            else:
-                self.xe_step = make_parallel_xe_step(
-                    self.model, self.mesh, cfg.train.label_smoothing,
-                    donate=True, guard=self.guard,
-                )
-        else:
-            self.xe_step = make_xe_step(
-                self.model, cfg.train.label_smoothing, donate=True,
-                guard=self.guard,
-            )
+        self._build_xe_step()
 
         if multihost.is_multiprocess():
             # verifiable evidence the cluster actually formed (a degraded
@@ -236,22 +238,86 @@ class Trainer:
         self._resume_rl_batch = 0  # RL batches to skip in the next epoch
         self._rollbacks = 0        # divergence rollbacks consumed this run
         self._rl_batcher: Batcher | None = None
+        # drain-aware RL seam (README "Elastic training"): tokens the
+        # pipelined loop decoded but never scored before a drain; replayed
+        # by the resumed epoch so the seam batch is not re-decoded against
+        # fresher params
+        self._pending_seam: dict | None = None
+        # elastic multi-host resilience (resilience/health.py): a heartbeat
+        # monitor + peer-loss watchdog. The step loops poll `peer_lost` — a
+        # plain Event read, no host<->device traffic — only when enabled.
+        self.health: health_mod.HealthMonitor | None = None
+        self._degraded_gen = 0
+        self._all_mesh_devices = (
+            list(self.mesh.devices.flat) if self.mesh is not None else None
+        )
+        self._initial_hosts = 1
+        if cfg.train.health:
+            health_mod.set_dcn_stall_threshold(cfg.train.dcn_stall_s)
+            num_hosts = cfg.train.health_sim_hosts or jax.process_count()
+            self._initial_hosts = num_hosts
+            self.health = health_mod.HealthMonitor(
+                cfg.train.health_dir
+                or os.path.join(cfg.train.ckpt_dir, "health"),
+                host_id=jax.process_index(),
+                num_hosts=num_hosts,
+                interval_s=cfg.train.health_interval_s,
+                timeout_s=cfg.train.peer_timeout_s,
+                misses=cfg.train.health_misses,
+                log=self.log.log,
+            ).start()
         if cfg.train.resume:
             self._resume()
 
+        self._build_validator()
+        setup_span.end()
+
+    def _build_xe_step(self) -> None:
+        """(Re)build the jitted XE step for the CURRENT mesh — called at init
+        and again after a degraded-mesh rebuild."""
+        cfg = self.cfg
+        if self.mesh is not None:
+            if self.sp:
+                # SP params are layout-identical to the plain model's, so the
+                # state init above (plain model) feeds the SP step directly
+                # donate=True: the step consumes self.state (rebound on every
+                # call), so params + Adam moments update in place instead of
+                # double-buffering — HBM headroom on the production path
+                self.xe_step = make_sp_xe_step(
+                    sp_model(cfg.model), self.mesh, cfg.train.label_smoothing,
+                    data_axis="data", donate=True, guard=self.guard,
+                )
+            else:
+                self.xe_step = make_parallel_xe_step(
+                    self.model, self.mesh, cfg.train.label_smoothing,
+                    donate=True, guard=self.guard,
+                )
+        else:
+            self.xe_step = make_xe_step(
+                self.model, cfg.train.label_smoothing, donate=True,
+                guard=self.guard,
+            )
+
+    def _build_validator(self) -> None:
+        cfg = self.cfg
         self.validator = (
             Evaluator(
                 self.model,
-                val_ds,
+                self.val_ds,
                 EvalConfig(beam_size=1, max_len=cfg.model.max_len,
                            metrics=("CIDEr-D",)),
                 batch_size=cfg.data.batch_size,
                 mesh=self.mesh,
             )
-            if val_ds is not None
+            if self.val_ds is not None
             else None
         )
-        setup_span.end()
+
+    def close(self) -> None:
+        """Stop background machinery (the health watchdog). Safe to call
+        twice; the monitor thread is a daemon either way."""
+        if self.health is not None:
+            self.health.stop()
 
     # ---- resume / handoff --------------------------------------------------
 
@@ -269,6 +335,24 @@ class Trainer:
             self.log.log("resume_not_found", dir=src_dir)
             return
         state, infos = restored
+        batch_index, phase = self._adopt_restored(state, infos, src_dir)
+        # surface config drift between the checkpoint and this run
+        saved_cfg = infos.get("config")
+        if saved_cfg:
+            # one json round-trip canonicalizes tuples to lists, matching the
+            # JSON-born saved snapshot leaf for leaf
+            drift = _config_drift(saved_cfg, json.loads(self.cfg.to_json()))
+            if drift:
+                self.log.log("resume_config_drift", fields=drift)
+        self.log.log(
+            "resume", dir=src_dir, step=int(state.step), epoch=self.epoch,
+            batch_index=batch_index, phase=phase or "epoch_end",
+        )
+
+    def _adopt_restored(self, state, infos: dict, src_dir: str) -> tuple[int, str]:
+        """Install a restored state + its resume bookkeeping (shared by
+        resume-at-startup and the degraded-mesh continuation). Returns the
+        restored ``(batch_index, phase)``."""
         self.state = (
             replicate(self.mesh, state) if self.mesh is not None else state
         )
@@ -287,23 +371,68 @@ class Trainer:
         # replays exactly the remainder under the same epoch-keyed shuffle
         batch_index = int(infos.get("batch_index", 0))
         phase = infos.get("phase", "")
+        self._resume_batch = self._resume_rl_batch = 0
         if batch_index and phase == "xe":
             self._resume_batch = batch_index
         elif batch_index and phase == "rl":
             self._resume_rl_batch = batch_index
         self.batcher.salt = int(infos.get("data_salt", 0))
-        # surface config drift between the checkpoint and this run
-        saved_cfg = infos.get("config")
-        if saved_cfg:
-            # one json round-trip canonicalizes tuples to lists, matching the
-            # JSON-born saved snapshot leaf for leaf
-            drift = _config_drift(saved_cfg, json.loads(self.cfg.to_json()))
-            if drift:
-                self.log.log("resume_config_drift", fields=drift)
+        self._pending_seam = self._load_seam(src_dir, infos)
+        return batch_index, phase
+
+    # ---- drain-aware RL seam ------------------------------------------------
+
+    @staticmethod
+    def _seam_bytes(seam: dict, epoch: int, batch_index: int) -> bytes:
+        """Serialize a captured seam (scst._seam_capture output) + its
+        position as an npz blob for the checkpoint's extra_files."""
+        arrays = {
+            "samples": np.asarray(seam["samples"]),
+            "video_ids": np.asarray([str(v) for v in seam["video_ids"]]),
+            "epoch": np.asarray(int(epoch)),
+            "batch_index": np.asarray(int(batch_index)),
+        }
+        if seam.get("greedy") is not None:
+            arrays["greedy"] = np.asarray(seam["greedy"])
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        return buf.getvalue()
+
+    def _load_seam(self, src_dir: str, infos: dict) -> dict | None:
+        """Load the seam sidecar of the checkpoint that just restored (if
+        its save drained a pipelined RL epoch)."""
+        name = infos.get("ckpt_name", "")
+        if not name or infos.get("phase") != "rl" \
+                or not infos.get("batch_index"):
+            return None
+        path = os.path.join(src_dir, name, "seam.npz")
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                seam = {
+                    "samples": np.asarray(z["samples"]),
+                    "greedy": (
+                        np.asarray(z["greedy"]) if "greedy" in z.files
+                        else None
+                    ),
+                    "video_ids": [str(v) for v in z["video_ids"]],
+                    "epoch": int(z["epoch"]),
+                    "batch_index": int(z["batch_index"]),
+                }
+        except (OSError, ValueError, KeyError) as e:
+            # a torn/legacy seam degrades to the old re-decode behavior —
+            # never to a crash or to silently wrong tokens
+            self.log.log(
+                "seam_unreadable", path=path, error=type(e).__name__,
+                detail=str(e),
+            )
+            return None
         self.log.log(
-            "resume", dir=src_dir, step=int(state.step), epoch=self.epoch,
-            batch_index=batch_index, phase=phase or "epoch_end",
+            "seam_loaded", ckpt=name, epoch=seam["epoch"],
+            batch_index=seam["batch_index"],
         )
+        return seam
 
     def load_params_from(self, ckpt_dir: str, name: str = "best"):
         """XE -> RL handoff: params only, fresh optimizer (SURVEY.md §5)."""
@@ -414,26 +543,39 @@ class Trainer:
             "config": self.cfg.to_dict(),
         }
 
-    def _save_step_ckpt(self, phase: str, step_no: int, batch_index: int) -> None:
+    def _save_step_ckpt(self, phase: str, step_no: int, batch_index: int,
+                        seam: dict | None = None) -> None:
         """Mid-epoch checkpoint (step-interval or preemption-triggered):
-        records the exact batch index so resume replays the epoch remainder."""
+        records the exact batch index so resume replays the epoch remainder.
+        ``seam`` (drain-aware RL saves) rides along as ``seam.npz`` in the
+        same atomic swap."""
         if jax.process_index() == 0:
+            extra = None
+            if seam:
+                extra = {
+                    "seam.npz": self._seam_bytes(
+                        seam, self.epoch, batch_index
+                    ),
+                }
             with obs.span("ckpt", kind="step"):
                 self.ckpt.save_step(
                     jax.device_get(self.state), step_no,
                     self._ckpt_infos(phase, batch_index, step_no),
+                    extra_files=extra,
                 )
         self.log.log(
             "ckpt_step", phase=phase, step=step_no, batch_index=batch_index,
+            seam=bool(seam),
         )
 
     def _preempt_save(self, phase: str, step_no: int, batch_index: int,
-                      sentinel: DivergenceSentinel) -> None:
+                      sentinel: DivergenceSentinel,
+                      seam: dict | None = None) -> None:
         """SIGTERM landed: flush pending divergence checks (never checkpoint
         an update the sentinel would have rejected), save mid-epoch, make the
         event log durable, and unwind via :class:`Preempted`."""
         sentinel.flush()
-        self._save_step_ckpt(phase, step_no, batch_index)
+        self._save_step_ckpt(phase, step_no, batch_index, seam=seam)
         self.log.log(
             "preempt", phase=phase, step=step_no, batch_index=batch_index,
         )
@@ -442,6 +584,30 @@ class Trainer:
             f"preempted at {phase} step {step_no} "
             f"(epoch {self.epoch + 1}, batch {batch_index}); "
             "checkpoint saved — rerun with train.resume='auto'"
+        )
+
+    def _peer_loss_save(self, phase: str, step_no: int, batch_index: int,
+                        sentinel: DivergenceSentinel,
+                        seam: dict | None = None) -> None:
+        """A peer host was lost (heartbeat timeout / partial preemption):
+        coordinated DRAIN — the in-flight step finished, prefetch is about
+        to be flushed by the epoch unwind — then a durable mid-epoch save in
+        drain-aware order, then :class:`PeerLost` so the caller picks
+        degraded continuation or the strict full-restart fallback."""
+        sentinel.flush()
+        self._save_step_ckpt(phase, step_no, batch_index, seam=seam)
+        lost = self.health.lost()
+        obs.counter("resilience.peer_loss_drain").inc()
+        self.log.log(
+            "peer_loss_drain", phase=phase, step=step_no,
+            batch_index=batch_index, lost=lost,
+        )
+        self.log.flush()
+        raise PeerLost(
+            lost,
+            f"lost host(s) {lost} at {phase} step {step_no} "
+            f"(epoch {self.epoch + 1}, batch {batch_index}); drained and "
+            "saved — continuing degraded or restart with train.resume='auto'",
         )
 
     def _apply_rollback(self, phase: str, err: RollbackRequested,
@@ -471,8 +637,10 @@ class Trainer:
         self.rl_epochs = int(infos.get("rl_epochs", 0))
         # the in-progress epoch restarts from batch 0 under the new salt (a
         # mid-epoch checkpoint's batch_index indexes the OLD order — it no
-        # longer names the same batches, so it must not be replayed)
+        # longer names the same batches, so it must not be replayed; ditto
+        # any pending seam tokens, which belong to the old order)
         self._resume_batch = self._resume_rl_batch = 0
+        self._pending_seam = None
         self.batcher.salt = self._rollbacks
         if self._rl_batcher is not None:
             self._rl_batcher.salt = self._rollbacks
@@ -486,6 +654,121 @@ class Trainer:
             restored_epoch=self.epoch,
             salt=self._rollbacks,
         )
+
+    # ---- degraded-mesh continuation -----------------------------------------
+
+    def _surviving_devices(self, survivors: list[int]) -> list:
+        """Devices of the surviving hosts, in the original mesh order.
+
+        Real multi-process clusters map hosts to ``device.process_index``;
+        simulated hosts (train.health_sim_hosts) split the mesh's device
+        list evenly — host k owns the k-th contiguous chunk."""
+        if multihost.is_multiprocess():
+            alive = set(survivors)
+            return [
+                d for d in self._all_mesh_devices
+                if d.process_index in alive
+            ]
+        per_host = max(1, len(self._all_mesh_devices) // self._initial_hosts)
+        out = []
+        for h in survivors:
+            out.extend(self._all_mesh_devices[h * per_host:(h + 1) * per_host])
+        return out
+
+    def _continue_degraded(self, phase: str, err: PeerLost) -> None:
+        """Elastic continuation after a drained peer loss: rendezvous the
+        survivors (retry/timeout/backoff), rebuild a SHRUNK 1-D data mesh
+        over the surviving devices, reshard params + optimizer state from
+        the last durable checkpoint (the drain just wrote one, seam
+        included), rescale the per-host batch share, and let the phase loop
+        replay the epoch remainder."""
+        cfg = self.cfg
+        if self.health is None or self._all_mesh_devices is None:
+            raise err  # elastic continuation needs the monitor AND a mesh
+        if self.sp:
+            raise RuntimeError(
+                "degraded-mesh continuation does not support the "
+                "('data','seq') mesh — a lost host takes part of every seq "
+                "row with it; run elastic='strict' with seq_devices > 1"
+            ) from err
+        self._degraded_gen += 1
+        expected = self.health.survivors()
+        with obs.span("degraded_rendezvous", generation=self._degraded_gen):
+            survivors = health_mod.rendezvous(
+                self.health.dir,
+                host_id=self.health.host_id,
+                hosts=expected,
+                generation=self._degraded_gen,
+                timeout_s=max(cfg.train.peer_timeout_s * 4.0, 1.0),
+            )
+        devices = self._surviving_devices(survivors)
+        n_data = len(devices)
+        if n_data == 0:
+            raise RuntimeError(
+                f"no devices survive the loss of host(s) {err.hosts}"
+            ) from err
+        if cfg.data.batch_size % n_data:
+            raise RuntimeError(
+                f"cannot continue degraded: global batch_size "
+                f"{cfg.data.batch_size} is not divisible by the {n_data} "
+                "surviving devices — run elastic='strict' or pick a batch "
+                "size divisible by every survivable mesh width"
+            ) from err
+        self.mesh = Mesh(np.asarray(devices), ("data",))
+        # per-host batch rescaling: the GLOBAL batch is unchanged, each
+        # surviving host's share grows to cover the lost host's rows
+        if multihost.is_multiprocess():
+            shard = (survivors.index(jax.process_index()), len(survivors))
+            self.batcher = self._rebuild_batcher(self.batcher, shard)
+        # reshard params + optimizer state from the last durable checkpoint
+        # onto the shrunk mesh (the peer-loss drain saved one moments ago,
+        # with the exact batch index + pipeline seam)
+        restored = self.ckpt.restore_latest(jax.device_get(self.state))
+        if restored is None:
+            raise RuntimeError(
+                "degraded continuation found no restorable checkpoint in "
+                f"{cfg.train.ckpt_dir} — the peer-loss drain save is missing"
+            ) from err
+        state, infos = restored
+        batch_index, res_phase = self._adopt_restored(
+            state, infos, cfg.train.ckpt_dir
+        )
+        self._build_xe_step()
+        self._build_validator()
+        self.health.set_membership(survivors)
+        self.health.acknowledge()
+        self._all_mesh_devices = devices
+        self._initial_hosts = len(survivors)
+        obs.counter("resilience.degraded_continuation").inc()
+        obs.event(
+            "degraded_mesh", phase=phase, lost=err.hosts,
+            survivors=survivors, devices=n_data,
+        )
+        self.log.log(
+            "degraded_mesh",
+            phase=phase,
+            lost=err.hosts,
+            survivors=survivors,
+            devices=n_data,
+            global_batch=cfg.data.batch_size,
+            resumed_phase=res_phase,
+            resumed_batch_index=batch_index,
+        )
+
+    def _rebuild_batcher(self, old: Batcher, host_shard: tuple[int, int]) -> Batcher:
+        """Same data order, new host share (degraded multi-process only)."""
+        new = Batcher(
+            self.train_ds,
+            batch_size=old.batch_size,
+            max_len=old.max_len,
+            mode=old.mode,
+            seq_per_vid=old.seq_per_vid,
+            seed=old.seed,
+            host_shard=host_shard,
+        )
+        new.epoch_index = old.epoch_index
+        new.salt = old.salt
+        return new
 
     # ---- XE phase ----------------------------------------------------------
 
@@ -521,6 +804,14 @@ class Trainer:
                     last_val = self._xe_epoch(meter, profiler, sentinel, pre, run)
                 except RollbackRequested as e:
                     self._apply_rollback("xe", e, sentinel)
+                except PeerLost as e:
+                    # strict keeps today's abort-and-full-restart (the saved
+                    # drain resumes bit-exactly on the full mesh); degraded
+                    # shrinks the mesh and keeps training on the survivors
+                    if self.cfg.train.elastic != "degraded":
+                        raise
+                    self._continue_degraded("xe", e)
+                    run["first_step"] = True  # recompile on the shrunk mesh
         return last_val
 
     def _xe_epoch(self, meter, profiler, sentinel, pre, run) -> float | None:
@@ -589,8 +880,14 @@ class Trainer:
                             cfg.data.batch_size * self._xe_flops_per_row
                         )
                         chaos.visit("xe.step")
+                        if self.health is not None:
+                            self.health.note_step(step_no)
                         if pre.requested:
                             self._preempt_save("xe", step_no, batch_no, sentinel)
+                        if self.health is not None and self.health.peer_lost:
+                            self._peer_loss_save(
+                                "xe", step_no, batch_no, sentinel
+                            )
                         if ckpt_every and step_no % ckpt_every == 0:
                             # never save an update the policy rejects
                             sentinel.flush()
@@ -602,6 +899,8 @@ class Trainer:
             # the epoch counters advance past the state actually saved
             if pre.requested:
                 self._preempt_save("xe", step_no, batch_no, sentinel)
+            if self.health is not None and self.health.peer_lost:
+                self._peer_loss_save("xe", step_no, batch_no, sentinel)
             sentinel.flush()
         self.epoch += 1
         self.xe_epochs += 1
@@ -623,15 +922,15 @@ class Trainer:
         ``epochs=None``: ``cfg.rl.epochs`` is the phase TOTAL (see train_xe).
 
         Resilience mirrors the XE loop: divergence sentinel on every update,
-        SIGTERM stops the epoch at the next batch boundary (the pipeline
-        drains, so the saved state matches exactly ``batch_index`` completed
-        steps). A mid-epoch resume replays the remainder of the epoch: with
-        ``rl.pipelined=False`` that is bit-identical to the uninterrupted
-        run; the pipelined loop re-decodes the seam batch against params one
-        update fresher than the uninterrupted schedule would have (the
-        decode staleness is the pipeline's documented property — see
-        SCSTTrainer.train_epoch), after which the streams re-converge
-        structurally (same rng, same batches).
+        SIGTERM (or a detected peer loss) stops the epoch at the next batch
+        boundary and the pipeline drains in SCHEDULE ORDER: the saved state
+        matches exactly ``batch_index`` completed steps, and the pipelined
+        loop additionally decodes the seam batch at its exact pipeline
+        position and persists the tokens (``seam.npz``) next to the state.
+        A mid-epoch resume replays the remainder of the epoch and the seam
+        tokens, so BOTH ``rl.pipelined`` modes resume bit-identically to the
+        uninterrupted run (previously the pipelined resume re-decoded the
+        seam batch against params one update fresher).
         """
         cfg = self.cfg
         if epochs is None:
@@ -677,20 +976,26 @@ class Trainer:
             bleu_scale=cfg.rl.reward_bleu4_scale,
             num_threads=cfg.rl.reward_threads,
         )
-        scst = SCSTTrainer(
-            self.model, reward, cfg.rl, mesh=self.mesh,
-            max_len=cfg.model.max_len, donate=True, guard=self.guard,
-            on_event=self.log.log,
-        )
-        rl_batcher = Batcher(
-            self.train_ds,
-            batch_size=cfg.data.batch_size,
-            max_len=cfg.model.max_len,
-            mode="video",
-            seed=cfg.data.shuffle_seed,
-            host_shard=multihost.host_shard() if self.use_mesh else (0, 1),
-        )
-        rl_batcher.salt = self.batcher.salt
+        def build_scst():
+            """SCST step closures + batcher for the CURRENT mesh — rebuilt
+            after a degraded-mesh continuation shrinks it."""
+            scst = SCSTTrainer(
+                self.model, reward, cfg.rl, mesh=self.mesh,
+                max_len=cfg.model.max_len, donate=True, guard=self.guard,
+                on_event=self.log.log,
+            )
+            rl_batcher = Batcher(
+                self.train_ds,
+                batch_size=cfg.data.batch_size,
+                max_len=cfg.model.max_len,
+                mode="video",
+                seed=cfg.data.shuffle_seed,
+                host_shard=self.batcher.host_shard if self.use_mesh else (0, 1),
+            )
+            rl_batcher.salt = self.batcher.salt
+            return scst, rl_batcher
+
+        scst, rl_batcher = build_scst()
         self._rl_batcher = rl_batcher
         target = self.rl_epochs + epochs
         meter = obs.StepMeter("rl")
@@ -714,6 +1019,15 @@ class Trainer:
                         )
                     except RollbackRequested as e:
                         self._apply_rollback("rl", e, sentinel)
+                    except PeerLost as e:
+                        if self.cfg.train.elastic != "degraded":
+                            raise
+                        self._continue_degraded("rl", e)
+                        # the decode/update closures and the batcher's host
+                        # share are mesh-shaped: rebuild on the shrunk mesh
+                        scst, rl_batcher = build_scst()
+                        self._rl_batcher = rl_batcher
+                        run["first_step"] = True
         finally:
             self._rl_batcher = None
         return last_val
@@ -729,6 +1043,23 @@ class Trainer:
         rl_batcher.epoch_index = self.epoch
         skip = self._resume_rl_batch
         self._resume_rl_batch = 0
+        # drain-aware seam replay: the tokens the drained pipeline decoded
+        # for exactly this (epoch, batch) position — replayed so the seam
+        # batch is not re-decoded against params one update fresher than
+        # the uninterrupted schedule. Anything else (position mismatch,
+        # strict pipeline off) falls back to the old re-decode.
+        seam = None
+        if skip and self._pending_seam is not None:
+            cand, self._pending_seam = self._pending_seam, None
+            if cfg.rl.pipelined and cand["epoch"] == self.epoch \
+                    and cand["batch_index"] == skip:
+                seam = cand
+            else:
+                self.log.log(
+                    "seam_discarded", epoch=self.epoch, batch_index=skip,
+                    seam_epoch=cand["epoch"],
+                    seam_batch_index=cand["batch_index"],
+                )
         # per-epoch sampling rng is FOLDED from the global epoch, not drawn
         # from a running split chain, so a resumed phase continues the stream
         # (epoch k uses fold_in(base, k) whether or not the process
@@ -775,6 +1106,8 @@ class Trainer:
             meter.tick(cfg.data.batch_size, first=run["first_step"])
             run["first_step"] = False
             chaos.visit("rl.step")
+            if self.health is not None:
+                self.health.note_step(step_counter["step"])
 
         # pipelined epoch (rl.pipelined, default): host reward for batch i
         # overlaps device update i-1 + decode i+1; batches are prefetched
@@ -785,6 +1118,10 @@ class Trainer:
         # the rl.epoch span's self time is everything the decode/reward/
         # update spans inside scst.train_epoch don't claim: input-pipeline
         # waits, rng bookkeeping, drain stalls
+        # drain-aware stop: the pipelined loop decodes the seam batch at
+        # its exact schedule position and captures the tokens here; the
+        # preemption/peer-loss save persists them next to the state
+        seam_sink: dict = {}
         with obs.span("rl.epoch"):
             try:
                 self.state, _ = scst.train_epoch(
@@ -794,14 +1131,24 @@ class Trainer:
                     ep_rng,
                     on_step=on_step,
                     pipelined=cfg.rl.pipelined,
-                    should_stop=lambda: pre.requested,
+                    should_stop=lambda: pre.requested or (
+                        self.health is not None and self.health.peer_lost
+                    ),
+                    seam=seam,
+                    seam_sink=seam_sink if cfg.rl.pipelined else None,
                 )
             finally:
                 stop.set()
             profiler.stop()
             if pre.requested:
                 self._preempt_save(
-                    "rl", step_counter["step"], batch_counter["n"], sentinel
+                    "rl", step_counter["step"], batch_counter["n"], sentinel,
+                    seam=seam_sink or None,
+                )
+            if self.health is not None and self.health.peer_lost:
+                self._peer_loss_save(
+                    "rl", step_counter["step"], batch_counter["n"], sentinel,
+                    seam=seam_sink or None,
                 )
             sentinel.flush()
         self.epoch += 1

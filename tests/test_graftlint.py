@@ -669,11 +669,11 @@ def test_cli_write_baseline_then_clean(tmp_path, capsys):
     assert cli_main([str(path), "--root", str(tmp_path)]) == 0
 
 
-def test_cli_list_rules_names_all_eleven(tmp_path, capsys):
+def test_cli_list_rules_names_all_registered(tmp_path, capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008", "GL009", "GL010", "GL011"):
+                "GL007", "GL008", "GL009", "GL010", "GL011", "GL012"):
         assert rid in out
 
 
@@ -827,3 +827,78 @@ def test_scripts_import_without_backend_init():
         capture_output=True, text=True, timeout=240,
     )
     assert proc.returncode == 0, proc.stderr
+
+
+# ---- GL012: collective-axis-name typos --------------------------------------
+
+def test_gl012_positive_psum_axis_typo(tmp_path):
+    """A misspelled mesh axis in a collective is the exact hazard: an
+    unbound-axis trace error (or wrong-axis reduction) deep inside
+    shard_map."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.lax.psum(x, 'dta')\n"
+    ), rules=["GL012"])
+    assert _rules_of(findings) == ["GL012"]
+    assert findings[0].severity == "error"
+    assert "'dta'" in findings[0].message
+
+
+def test_gl012_positive_axis_name_kwarg_and_tuple(tmp_path):
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def f(x):\n"
+        "    a = jax.lax.pmean(x, axis_name='sequ')\n"
+        "    b = jax.lax.psum(x, ('data', 'seqq'))\n"
+        "    return a, b\n"
+    ), rules=["GL012"])
+    assert len(findings) == 2
+    assert all(f.rule == "GL012" for f in findings)
+
+
+def test_gl012_negative_declared_axes_and_dynamic_names(tmp_path):
+    """Axes declared by train/mesh.py pass; dynamic axis expressions are
+    out of scope (not statically checkable)."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def f(x, axis):\n"
+        "    a = jax.lax.psum(x, 'data')\n"
+        "    b = jax.lax.pmean(x, 'seq')\n"
+        "    c = jax.lax.axis_index('data')\n"
+        "    d = jax.lax.psum(x, axis)\n"
+        "    return a, b, c, d\n"
+    ), rules=["GL012"])
+    assert findings == []
+
+
+def test_gl012_axes_extracted_from_mesh_py(tmp_path):
+    """The allowed set comes from the *axis-parameter defaults declared by
+    train/mesh.py under the lint root, not a hardcoded list."""
+    mesh = tmp_path / "cst_captioning_tpu" / "train" / "mesh.py"
+    mesh.parent.mkdir(parents=True, exist_ok=True)
+    mesh.write_text(
+        "def make_mesh(num_devices=0, axis='model', seq_axis='pipeline'):\n"
+        "    pass\n"
+    )
+    good = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.lax.psum(x, 'model')\n"
+    ), rules=["GL012"])
+    assert good == []
+    bad = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.lax.psum(x, 'data')\n"  # not declared by THIS mesh.py
+    ), rules=["GL012"])
+    assert _rules_of(bad) == ["GL012"]
+
+
+def test_gl012_negative_tests_out_of_scope(tmp_path):
+    findings = _lint(tmp_path, "tests/test_mod.py", (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.lax.psum(x, 'i')\n"
+    ), rules=["GL012"])
+    assert findings == []

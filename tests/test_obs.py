@@ -722,3 +722,116 @@ def test_observe_device_memory_statless_backend(monkeypatch):
     monkeypatch.setattr(jax, "local_devices", lambda: [NoStats()])
     assert m.observe_device_memory(reg) is False
     assert reg.snapshot()["gauges"] == {}
+
+
+# ---- multihost report merge + health/DCN sections ---------------------------
+
+def _write_stream(path, events):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def _proc_events(t0, t1, phase_self, counters=None, gauges=None,
+                 histograms=None):
+    return [
+        {"ts": t0, "event": "run_start", "run": "multi", "thread": "MainThread"},
+        {"ts": t0 + 0.1, "event": "span", "name": "rl.decode",
+         "dur": phase_self, "self_dur": phase_self, "thread": "MainThread"},
+        {"ts": t1 - 0.01, "event": "metrics",
+         "counters": counters or {}, "gauges": gauges or {},
+         "histograms": histograms or {}},
+        {"ts": t1, "event": "run_end"},
+    ]
+
+
+def test_report_merges_proc_streams_with_skew_attribution(tmp_path):
+    """proc<k>/ sub-streams merge into hosts/cluster sections: per-host
+    start/end skew names the straggler, counters sum cluster-wide."""
+    run = str(tmp_path / "run")
+    _write_stream(
+        os.path.join(run, "events.jsonl"),
+        _proc_events(100.0, 110.0, 5.0,
+                     counters={"resilience.chaos_fault": 1,
+                               "health.dcn_stall": 1,
+                               "health.heartbeats": 7}),
+    )
+    _write_stream(
+        os.path.join(run, "proc1", "events.jsonl"),
+        _proc_events(100.5, 113.0, 9.0,
+                     counters={"resilience.chaos_fault": 2,
+                               "health.peer_lost": 1,
+                               "health.heartbeats": 5}),
+    )
+    rep = report_run(run)
+    assert [h["proc"] for h in rep["hosts"]] == [0, 1]
+    h0, h1 = rep["hosts"]
+    assert h0["start_skew_s"] == pytest.approx(0.0)
+    assert h1["start_skew_s"] == pytest.approx(0.5)
+    assert h0["end_skew_s"] == pytest.approx(0.0)
+    assert h1["end_skew_s"] == pytest.approx(3.0)
+    assert h1["top_phase"] == "rl.decode"
+    c = rep["cluster"]
+    assert c["processes"] == 2 and c["straggler_proc"] == 1
+    assert c["max_end_skew_s"] == pytest.approx(3.0)
+    assert c["chaos_faults"] == 3
+    assert c["dcn_stalls"] == 1 and c["peer_losses"] == 1
+    assert c["heartbeats"] == 12
+    # rendering includes the cluster table without touching the phase table
+    text = render_report(rep)
+    assert "cluster: 2 process streams merged" in text
+    assert "proc" in text
+
+
+def test_report_single_stream_has_no_cluster_section(tmp_path):
+    run = str(tmp_path / "run")
+    _write_stream(os.path.join(run, "events.jsonl"),
+                  _proc_events(0.0, 1.0, 0.5))
+    rep = report_run(run)
+    assert "hosts" not in rep and "cluster" not in rep
+
+
+def test_report_health_section_surfaces_heartbeats_and_dcn_stalls(tmp_path):
+    run = str(tmp_path / "run")
+    hist = {"dcn.collective_seconds": {
+        "buckets": [0.1, 1.0], "counts": [8, 2], "sum": 2.4, "count": 10,
+        "max": 0.9,
+    }}
+    _write_stream(
+        os.path.join(run, "events.jsonl"),
+        _proc_events(0.0, 10.0, 1.0,
+                     counters={"health.heartbeats": 20,
+                               "health.dcn_stall": 2,
+                               "health.peer_lost": 1,
+                               "resilience.peer_loss_drain": 1,
+                               "resilience.degraded_continuation": 1,
+                               "resilience.ckpt_enospc": 3,
+                               "resilience.prefetch_stall": 4},
+                     gauges={"health.peers_alive": 1.0,
+                             "health.peer_age_max_s": 0.2},
+                     histograms=hist),
+    )
+    rep = report_run(run)
+    h = rep["health"]
+    assert h["heartbeats"] == 20 and h["dcn_stalls"] == 2
+    assert h["peer_losses"] == 1 and h["peers_alive"] == 1.0
+    assert h["collectives"] == 10
+    assert 0.0 < h["collective_p95_s"] <= 0.9
+    r = rep["resilience"]
+    assert r["peer_loss_drains"] == 1
+    assert r["degraded_continuations"] == 1
+    assert r["ckpt_enospc"] == 3 and r["prefetch_stalls"] == 4
+    text = render_report(rep)
+    assert "health: 20 heartbeat(s)" in text
+    assert "2 stall(s)" in text
+    assert "peer-loss drains: 1" in text and "degraded continuations: 1" in text
+
+
+def test_report_no_health_section_without_signals(tmp_path):
+    run = str(tmp_path / "run")
+    _write_stream(os.path.join(run, "events.jsonl"),
+                  _proc_events(0.0, 1.0, 0.5))
+    rep = report_run(run)
+    assert rep["health"] is None
+    assert "health:" not in render_report(rep)

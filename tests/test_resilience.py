@@ -437,3 +437,359 @@ def test_event_logger_flush_and_double_close(tmp_path):
     assert [json.loads(l)["event"] for l in open(path)] == ["a"]
     log.close()
     log.close()  # idempotent (atexit may race a manual close)
+
+
+# ---- health.py: heartbeats, watchdog, rendezvous, DCN spans -----------------
+
+def _counter(name):
+    from cst_captioning_tpu import obs
+
+    return obs.counter(name).snapshot()
+
+
+def test_health_monitor_sees_peer_beats_and_detects_silence(tmp_path):
+    """Two monitors share a heartbeat dir; B goes silent -> A declares it
+    lost after timeout_s x misses (driven with an injected clock, no real
+    sleeps)."""
+    from cst_captioning_tpu.resilience.health import HealthMonitor
+
+    now = {"t": 0.0}
+    clock = lambda: now["t"]
+    a = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2, timeout_s=1.0,
+                      misses=2, clock=clock, start_thread=False).start()
+    b = HealthMonitor(str(tmp_path), host_id=1, num_hosts=2, timeout_s=1.0,
+                      misses=2, clock=clock, start_thread=False).start()
+    try:
+        b.beat()
+        assert a.poll() == []
+        assert not a.peer_lost and a.survivors() == [0, 1]
+        # B beats again later: stays alive
+        now["t"] = 0.9
+        b.beat()
+        assert a.poll() == []
+        # then goes silent: first stale poll is a strike, not a death...
+        now["t"] = 2.0
+        assert a.poll() == []
+        assert not a.peer_lost
+        # ...the second consecutive stale poll (the debounce) declares loss
+        now["t"] = 2.1
+        assert a.poll() == [1]
+        assert a.peer_lost and a.lost() == [1] and a.survivors() == [0]
+        # acknowledge clears the pending flag; the lost record stays
+        a.acknowledge()
+        assert not a.peer_lost and a.lost() == [1]
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_health_monitor_never_seen_peer_is_not_declared_dead(tmp_path):
+    """A simulated peer that never heartbeated must not be 'lost' by
+    staleness — only a tombstone (partial_preempt) can kill a phantom."""
+    from cst_captioning_tpu.resilience.health import HealthMonitor
+
+    now = {"t": 0.0}
+    mon = HealthMonitor(str(tmp_path), host_id=0, num_hosts=3, timeout_s=0.5,
+                        misses=1, clock=lambda: now["t"],
+                        start_thread=False).start()
+    try:
+        now["t"] = 100.0
+        assert mon.poll() == []
+        assert not mon.peer_lost
+    finally:
+        mon.stop()
+
+
+def test_health_simulate_loss_is_synchronous_and_leaves_tombstone(tmp_path):
+    from cst_captioning_tpu.resilience.health import HealthMonitor
+
+    mon = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2,
+                        start_thread=False).start()
+    try:
+        mon.simulate_loss(1)
+        assert mon.peer_lost and mon.lost() == [1]
+        assert os.path.exists(str(tmp_path / "host1.dead"))
+        with pytest.raises(ValueError):
+            mon.simulate_loss(0)  # self-preemption is the 'preempt' kind
+    finally:
+        mon.stop()
+
+
+def test_health_record_collective_refreshes_liveness(tmp_path):
+    """A completed collective is a piggybacked heartbeat: it resets the
+    staleness clock for every peer."""
+    from cst_captioning_tpu.resilience.health import HealthMonitor
+
+    now = {"t": 0.0}
+    a = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2, timeout_s=1.0,
+                      misses=1, clock=lambda: now["t"],
+                      start_thread=False).start()
+    b = HealthMonitor(str(tmp_path), host_id=1, num_hosts=2,
+                      start_thread=False).start()
+    try:
+        b.beat()
+        a.poll()
+        now["t"] = 5.0
+        a.record_collective()  # the barrier completed at t=5
+        now["t"] = 5.5        # < timeout since the collective
+        assert a.poll() == []
+        assert not a.peer_lost
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_health_watchdog_thread_beats_and_detects(tmp_path):
+    """Real-thread smoke: the watchdog writes heartbeats on its own and
+    detects a tombstoned peer without manual poll() calls."""
+    import time as _time
+
+    from cst_captioning_tpu.resilience.health import HealthMonitor
+
+    mon = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2,
+                        interval_s=0.02, timeout_s=5.0, misses=2).start()
+    try:
+        deadline = _time.monotonic() + 5.0
+        while not os.path.exists(str(tmp_path / "host0.hb")):
+            assert _time.monotonic() < deadline, "watchdog never beat"
+            _time.sleep(0.01)
+        # kill the phantom peer via tombstone; the thread must notice
+        with open(str(tmp_path / "host1.dead"), "w") as f:
+            f.write("{}")
+        while not mon.peer_lost:
+            assert _time.monotonic() < deadline, "watchdog never saw the tombstone"
+            _time.sleep(0.01)
+        assert mon.lost() == [1]
+    finally:
+        mon.stop()
+
+
+def test_rendezvous_completes_when_all_present(tmp_path):
+    from cst_captioning_tpu.resilience.health import rendezvous
+
+    # peer 1 already checked in (another process in production)
+    gen_dir = tmp_path / "rendezvous_0003"
+    gen_dir.mkdir()
+    (gen_dir / "host1.json").write_text('{"host": 1}')
+    got = rendezvous(str(tmp_path), host_id=0, hosts=[0, 1], generation=3,
+                     timeout_s=1.0, sleep=lambda s: None)
+    assert got == [0, 1]
+
+
+def test_rendezvous_times_out_naming_missing_hosts(tmp_path):
+    from cst_captioning_tpu.resilience.health import (
+        RendezvousTimeout,
+        rendezvous,
+    )
+
+    now = {"t": 0.0}
+
+    def sleep(s):
+        now["t"] += s
+
+    with pytest.raises(RendezvousTimeout, match=r"\[2\]"):
+        rendezvous(str(tmp_path), host_id=0, hosts=[0, 2], generation=0,
+                   timeout_s=1.0, clock=lambda: now["t"], sleep=sleep)
+
+
+def test_rendezvous_backoff_grows_poll_interval(tmp_path):
+    from cst_captioning_tpu.resilience.health import (
+        RendezvousTimeout,
+        rendezvous,
+    )
+
+    now = {"t": 0.0}
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        now["t"] += s
+
+    with pytest.raises(RendezvousTimeout):
+        rendezvous(str(tmp_path), host_id=0, hosts=[0, 1], generation=1,
+                   timeout_s=1.0, poll_s=0.1, backoff=2.0, max_poll_s=0.5,
+                   clock=lambda: now["t"], sleep=sleep)
+    assert sleeps[0] == pytest.approx(0.1)
+    assert sleeps[1] == pytest.approx(0.2)
+    assert max(sleeps) <= 0.5 + 1e-9  # capped
+
+
+def test_collective_span_emits_stall_event_past_threshold():
+    from cst_captioning_tpu.resilience.health import collective_span
+
+    before = _counter("health.dcn_stall")
+    with collective_span("test_fast", stall_threshold_s=1e9):
+        pass
+    assert _counter("health.dcn_stall") == before
+    with collective_span("test_stalled", stall_threshold_s=0.0):
+        pass  # any duration > 0 exceeds a zero threshold
+    assert _counter("health.dcn_stall") == before + 1
+
+
+# ---- chaos.py: new fault kinds ----------------------------------------------
+
+def test_chaos_partial_h2d_is_transient_and_retryable():
+    from cst_captioning_tpu.resilience.chaos import PartialTransferError
+
+    plan = FaultPlan([Fault("prefetch.h2d", "partial_h2d", at=0, times=1)])
+    with plan.activate():
+        with pytest.raises(PartialTransferError):
+            chaos.visit("prefetch.h2d")
+        chaos.visit("prefetch.h2d")  # next visit is clean
+    assert isinstance(PartialTransferError("x"), OSError)
+    assert [f["kind"] for f in plan.fired] == ["partial_h2d"]
+
+
+def test_chaos_enospc_fault_carries_errno():
+    import errno
+
+    plan = FaultPlan([Fault("ckpt.save", "enospc_rotation", at=0)])
+    with plan.activate():
+        with pytest.raises(OSError) as ei:
+            chaos.visit("ckpt.save")
+    assert ei.value.errno == errno.ENOSPC
+
+
+def test_chaos_partial_preempt_requires_active_monitor():
+    plan = FaultPlan([Fault("rl.step", "partial_preempt", at=0, host=1)])
+    with plan.activate():
+        with pytest.raises(RuntimeError, match="HealthMonitor"):
+            chaos.visit("rl.step")
+
+
+def test_chaos_partial_preempt_marks_peer_lost(tmp_path):
+    from cst_captioning_tpu.resilience.health import HealthMonitor
+
+    mon = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2,
+                        start_thread=False).start()
+    try:
+        plan = FaultPlan([Fault("rl.step", "partial_preempt", at=1, host=1)])
+        with plan.activate():
+            chaos.visit("rl.step")
+            assert not mon.peer_lost  # fires at visit 1, not 0
+            chaos.visit("rl.step")
+        assert mon.peer_lost and mon.lost() == [1]
+    finally:
+        mon.stop()
+
+
+def test_chaos_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        Fault("xe.step", "meteor_strike")
+
+
+def test_chaos_seeded_at_preserves_host_field():
+    plan = FaultPlan(
+        [Fault("rl.step", "partial_preempt", at=("rand", 2, 5), host=7)],
+        seed=11,
+    )
+    f = plan.faults[0]
+    assert 2 <= f.at < 5 and f.host == 7
+
+
+# ---- prefetch: slow/partial H2D + wedged-thread stall watchdog --------------
+
+def test_prefetch_partial_h2d_retried_and_all_items_arrive():
+    from cst_captioning_tpu.data.prefetch import prefetch_to_device
+
+    before = _counter("resilience.h2d_retry")
+    plan = FaultPlan([Fault("prefetch.h2d", "partial_h2d", at=1, times=1)])
+    with plan.activate():
+        got = list(prefetch_to_device(
+            iter(range(4)), size=2, transform=lambda x: x * 10, place=False,
+        ))
+    assert got == [0, 10, 20, 30]
+    assert [f["kind"] for f in plan.fired] == ["partial_h2d"]
+    assert _counter("resilience.h2d_retry") == before + 1
+
+
+def test_prefetch_partial_h2d_exhausting_retries_propagates():
+    from cst_captioning_tpu.data.prefetch import prefetch_to_device
+    from cst_captioning_tpu.resilience.chaos import PartialTransferError
+
+    plan = FaultPlan([Fault("prefetch.h2d", "partial_h2d", at=0, times=10)])
+    with plan.activate():
+        with pytest.raises(PartialTransferError):
+            list(prefetch_to_device(
+                iter(range(2)), size=1, place=False,
+            ))
+
+
+def test_prefetch_slow_h2d_delivers_everything():
+    from cst_captioning_tpu.data.prefetch import prefetch_to_device
+
+    plan = FaultPlan([Fault("prefetch.h2d", "slow_h2d", at=0, delay=0.05)])
+    with plan.activate():
+        got = list(prefetch_to_device(iter(range(3)), size=2, place=False))
+    assert got == [0, 1, 2]
+    assert plan.fired and plan.fired[0]["kind"] == "slow_h2d"
+
+
+def test_prefetch_wedged_worker_trips_stall_watchdog_then_recovers():
+    """A wedged prefetch thread starves the consumer past stall_warn_s: the
+    stall counter fires exactly once for the episode and the run RESUMES
+    when the thread unwedges — detection + continuation, not a crash."""
+    from cst_captioning_tpu.data.prefetch import prefetch_to_device
+
+    before = _counter("resilience.prefetch_stall")
+    plan = FaultPlan(
+        [Fault("prefetch.stage", "wedged_prefetch", at=1, delay=0.4)]
+    )
+    with plan.activate():
+        got = list(prefetch_to_device(
+            iter(range(3)), size=1, place=False, stall_warn_s=0.05,
+        ))
+    assert got == [0, 1, 2]
+    assert _counter("resilience.prefetch_stall") == before + 1
+    assert plan.fired and plan.fired[0]["kind"] == "wedged_prefetch"
+
+
+# ---- ckpt: ENOSPC-tolerant rotation -----------------------------------------
+
+def test_ckpt_enospc_reclaims_oldest_generation_and_retries(tiny_state, tmp_path):
+    """A full disk mid-save deletes the oldest step_* generation, logs a
+    structured ckpt_enospc event, and the budgeted retry then succeeds."""
+    sink = LogSink()
+    mgr = CheckpointManager(
+        str(tmp_path), keep=3, log=sink,
+        retry=RetryPolicy(max_attempts=3, base_delay=0.0, max_delay=0.0),
+    )
+    mgr.save_step(tiny_state, 100)
+    mgr.save_step(tiny_state, 200)
+    before = _counter("resilience.ckpt_enospc")
+    plan = FaultPlan([Fault("ckpt.save", "enospc_rotation", at=0, times=1)])
+    with plan.activate():
+        mgr.save_step(tiny_state, 300)
+    assert [f["kind"] for f in plan.fired] == ["enospc_rotation"]
+    # the save landed, the OLDEST generation paid for it
+    assert [s for s, _ in mgr.step_checkpoints()] == [200, 300]
+    (ev,) = sink.of("ckpt_enospc")
+    assert ev["freed"] == ["step_00000100"]
+    assert _counter("resilience.ckpt_enospc") == before + 1
+    state, infos = mgr.restore_latest(jax.device_get(tiny_state))
+    assert infos["global_step"] == 300
+
+
+def test_ckpt_enospc_with_nothing_to_reclaim_gives_up(tiny_state, tmp_path):
+    sink = LogSink()
+    mgr = CheckpointManager(
+        str(tmp_path), keep=3, log=sink,
+        retry=RetryPolicy(max_attempts=2, base_delay=0.0, max_delay=0.0),
+    )
+    plan = FaultPlan([Fault("ckpt.save", "enospc_rotation", at=0, times=5)])
+    with plan.activate():
+        with pytest.raises(OSError):
+            mgr.save_step(tiny_state, 100)
+    assert all(ev["freed"] == [] for ev in sink.of("ckpt_enospc"))
+
+
+def test_save_state_extra_files_ride_the_manifest(tiny_state, tmp_path):
+    save_state(str(tmp_path), "latest", tiny_state, {"epoch": 1},
+               extra_files={"seam.npz": b"not-really-npz"})
+    path = tmp_path / "latest"
+    assert (path / "seam.npz").read_bytes() == b"not-really-npz"
+    assert verify_manifest(str(path))
+    # corrupting the sidecar is caught exactly like a torn state file
+    (path / "seam.npz").write_bytes(b"torn")
+    with pytest.raises(CorruptCheckpointError, match="seam.npz"):
+        verify_manifest(str(path))
