@@ -637,11 +637,18 @@ class SCSTTrainer:
             len(valid_np) * self._update_flops_per_clip
         )
         with obs.span("rl.update"):
-            adv = jnp.asarray(advantage, jnp.float32)
-            valid = jnp.asarray(valid_np)
+            # host numpy goes straight to its TARGET sharding (explicit
+            # placement): converting to a single-device jnp array first
+            # would leave the sharded update to re-scatter it implicitly
+            # on every dispatch
+            adv = np.asarray(advantage, np.float32)
+            valid = np.asarray(valid_np, np.float32)
             if self.mesh is not None:
                 adv = multihost.from_host_local(adv, self.mesh, P(None, "data"))
                 valid = multihost.from_host_local(valid, self.mesh, P("data"))
+            else:
+                adv = jnp.asarray(adv, jnp.float32)
+                valid = jnp.asarray(valid)
             state, metrics = self.update(
                 state, feats, masks, samples, adv, valid
             )
@@ -790,6 +797,16 @@ class SCSTTrainer:
 
         Returns ``(state, metrics_list)``; ``on_step(metrics)`` fires per batch.
         """
+        if self.mesh is not None:
+            # replicate the epoch key onto the mesh ONCE: the sharded decode
+            # takes its rng replicated (in_specs P()), and a single-device
+            # key would otherwise be implicitly re-replicated device-to-
+            # device on EVERY batch's dispatch (the sanitizer gate's
+            # transfer_guard vetoes that); every split below inherits the
+            # replicated placement. Bit-identical — placement only.
+            from jax.sharding import NamedSharding
+
+            rng = jax.device_put(rng, NamedSharding(self.mesh, P()))
         out = []
 
         def emit(m):

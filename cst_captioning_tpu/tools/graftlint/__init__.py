@@ -11,18 +11,22 @@ from cst_captioning_tpu.tools.graftlint.core import (
     FileContext,
     Finding,
     LintResult,
+    ProjectRule,
     Rule,
     all_rules,
     find_repo_root,
     lint_paths,
     register,
 )
+from cst_captioning_tpu.tools.graftlint.project import ProjectIndex
 
 __all__ = [
     "Baseline",
     "FileContext",
     "Finding",
     "LintResult",
+    "ProjectIndex",
+    "ProjectRule",
     "Rule",
     "all_rules",
     "find_repo_root",

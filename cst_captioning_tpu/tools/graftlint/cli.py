@@ -3,9 +3,18 @@
     python -m cst_captioning_tpu.tools.graftlint [paths...] [--json]
         [--baseline PATH | --no-baseline] [--write-baseline]
         [--rules GL001,GL002] [--root DIR] [--list-rules]
+        [--check-stale] [--timings] [--budget SECONDS] [--no-cache]
 
 Exit codes: 0 = no new error/warning findings (info and baselined findings
-never gate), 1 = new findings, 2 = usage error.
+never gate), 1 = new findings / stale baseline or suppressions with
+--check-stale / budget exceeded with --budget, 2 = usage error.
+
+``--check-stale`` additionally fails the run when a ``graftlint.baseline``
+entry no longer fires or an inline ``# graftlint: disable=GLxxx`` suppresses
+nothing — dead grandfathers silently re-open the door for a finding to come
+back. The runtime counterpart of the static GL001/GL013 transfer claims is
+``scripts/sanitize.sh``, which runs a tier-1 subset under
+``pytest --sanitize`` (``jax.transfer_guard("disallow")`` + debug_nans).
 """
 
 from __future__ import annotations
@@ -52,6 +61,19 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit the machine-readable report on stdout")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
+    ap.add_argument("--check-stale", action="store_true",
+                    help="also fail on baseline entries that no longer fire "
+                         "and on unused inline disable= suppressions "
+                         "(requires the full rule set and a baseline)")
+    ap.add_argument("--timings", action="store_true",
+                    help="print the per-pass timing line (index build vs "
+                         "rule run) on stderr")
+    ap.add_argument("--budget", type=float, default=0.0, metavar="SECONDS",
+                    help="fail (exit 1) when index build + rule run exceed "
+                         "this wall-clock budget")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="skip the on-disk project-summary cache "
+                         "(<root>/.graftlint_cache.json)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -81,11 +103,30 @@ def main(argv: list[str] | None = None) -> int:
     baseline = None if args.no_baseline else Baseline.load(baseline_path)
 
     rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    if args.check_stale and (rule_ids is not None or baseline is None):
+        print("graftlint: --check-stale needs the full rule set and a "
+              "baseline (drop --rules / --no-baseline)", file=sys.stderr)
+        return 2
     try:
-        result = lint_paths(paths, root, baseline=baseline, rule_ids=rule_ids)
+        result = lint_paths(
+            paths, root, baseline=baseline, rule_ids=rule_ids,
+            cache_path="" if args.no_cache else None,
+        )
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
+
+    total_seconds = result.index_seconds + result.rules_seconds
+    if args.timings:
+        stats = result.index_stats
+        print(
+            f"graftlint: index {result.index_seconds:.3f}s "
+            f"({stats.get('files', 0)} files, "
+            f"{stats.get('summarized', 0)} summarized, "
+            f"{stats.get('cached', 0)} cached) + rules "
+            f"{result.rules_seconds:.3f}s = {total_seconds:.3f}s",
+            file=sys.stderr,
+        )
 
     if args.write_baseline:
         old = Baseline.load(baseline_path)
@@ -111,7 +152,35 @@ def main(argv: list[str] | None = None) -> int:
             f"({n_new} new, {n_base} baselined)",
             file=sys.stderr,
         )
-    return 1 if result.gating else 0
+
+    failed = bool(result.gating)
+    if args.check_stale:
+        for e in result.stale_baseline:
+            print(
+                f"graftlint: stale baseline entry: {e['rule']} at "
+                f"{e['path']} ({e['context']!r}) no longer fires "
+                f"({e['unfired']} unfired) — remove it from "
+                f"{BASELINE_NAME}",
+                file=sys.stderr,
+            )
+            failed = True
+        for s in result.unused_suppressions:
+            print(
+                f"graftlint: unused suppression: {s['path']}:{s['line']} "
+                f"disables {s['rule']} but nothing fires there — remove "
+                "the comment",
+                file=sys.stderr,
+            )
+            failed = True
+    if args.budget and total_seconds > args.budget:
+        print(
+            f"graftlint: pass took {total_seconds:.3f}s, over the "
+            f"{args.budget:.1f}s budget — the index cache or a rule "
+            "regressed",
+            file=sys.stderr,
+        )
+        failed = True
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -28,6 +28,7 @@ import dataclasses
 import json
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from io import StringIO
@@ -44,8 +45,14 @@ _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*(disable(?:-next-line)?)\s*=\s*([A-Za-z0-9_,\s]+)"
 )
 
-# directory names never descended into
-_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules", ".claude"}
+# directory names never descended into. "fixtures" keeps the deliberately
+# lint-dirty GL013/14/15 fixture pairs under tests/fixtures/ out of the
+# repo gate — tests lint them by passing the fixture directory explicitly
+# (os.walk only filters SUBdirectories of the given path).
+_SKIP_DIRS = {
+    ".git", "__pycache__", ".pytest_cache", "node_modules", ".claude",
+    "fixtures",
+}
 
 
 @dataclass
@@ -92,15 +99,31 @@ class FileContext:
     _cache: dict = field(default_factory=dict)
 
     @classmethod
-    def parse(cls, path: str, root: str) -> "FileContext":
-        with open(path, encoding="utf-8", errors="replace") as f:
-            source = f.read()
+    def parse(cls, path: str, root: str,
+              source: str | None = None,
+              tree: ast.Module | None = None) -> "FileContext":
+        """Parse ``path`` — or adopt an already-parsed (source, tree) pair
+        (pass 1 of the two-pass driver parses every file anyway; re-parsing
+        in pass 2 would double the lint's dominant cost)."""
+        if source is None:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                source = f.read()
         relpath = os.path.relpath(path, root).replace(os.sep, "/")
-        tree = ast.parse(source, filename=relpath)  # may raise SyntaxError
+        if tree is None:
+            tree = ast.parse(source, filename=relpath)  # may raise SyntaxError
         ctx = cls(path=path, relpath=relpath, root=root, source=source,
                   tree=tree, lines=source.splitlines())
         ctx.suppressions = _collect_suppressions(source)
         return ctx
+
+    def walk_nodes(self) -> list:
+        """Flat list of every AST node, cached: rules iterate the whole
+        tree a dozen times per file — one traversal, not fifteen."""
+        cached = self._cache.get("all_nodes")
+        if cached is None:
+            cached = list(ast.walk(self.tree))
+            self._cache["all_nodes"] = cached
+        return cached
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -129,6 +152,8 @@ class FileContext:
 def _collect_suppressions(source: str) -> dict[int, set[str]]:
     """Map line -> suppressed rule ids from ``# graftlint:`` comments."""
     out: dict[int, set[str]] = {}
+    if "graftlint:" not in source:
+        return out  # skip the tokenizer: most files carry no suppressions
     try:
         tokens = tokenize.generate_tokens(StringIO(source).readline)
         for tok in tokens:
@@ -162,6 +187,21 @@ class Rule:
         return True
 
     def check(self, ctx: FileContext) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class ProjectRule(Rule):
+    """A rule that sees past the file: :meth:`check_project` receives the
+    pass-1 :class:`~.project.ProjectIndex` alongside the per-file context.
+    Findings still anchor to lines of ``ctx`` (and per-line suppressions /
+    the baseline apply unchanged) — the index only widens what the rule can
+    *know*, not where it reports."""
+
+    def check(self, ctx: FileContext) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError("ProjectRule runs via check_project")
+
+    def check_project(self, ctx: FileContext,
+                      index) -> list[Finding]:  # pragma: no cover
         raise NotImplementedError
 
 
@@ -241,6 +281,24 @@ class Baseline:
                 budget[key] -= 1
                 f.baselined = True
 
+    def stale_entries(self, findings: list[Finding]) -> list[dict]:
+        """Entries whose fingerprint no longer fires (or fires fewer times
+        than its grandfathered count): the code site was fixed, so the
+        grandfather must go too — a stale entry silently re-opens the door
+        for the finding to come back."""
+        budget = self._counts()
+        for f in findings:
+            key = f.fingerprint()
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+        stale, seen = [], set()
+        for e in self.entries:
+            key = (e["rule"], e["path"], e["context"])
+            if budget.get(key, 0) > 0 and key not in seen:
+                seen.add(key)
+                stale.append(dict(e, unfired=budget[key]))
+        return stale
+
     @classmethod
     def from_findings(cls, findings: list[Finding],
                       old: "Baseline | None" = None) -> "Baseline":
@@ -299,6 +357,14 @@ def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
 class LintResult:
     findings: list[Finding]
     files_checked: int
+    # --check-stale surfaces: baseline entries that no longer fire, and
+    # inline `# graftlint: disable=` ids that suppressed nothing
+    stale_baseline: list[dict] = field(default_factory=list)
+    unused_suppressions: list[dict] = field(default_factory=list)
+    # per-pass wall-clock (index build vs rule run) for the lint.sh budget
+    index_seconds: float = 0.0
+    rules_seconds: float = 0.0
+    index_stats: dict = field(default_factory=dict)
 
     @property
     def new(self) -> list[Finding]:
@@ -322,6 +388,13 @@ class LintResult:
             "files_checked": self.files_checked,
             "counts": counts,
             "findings": [f.to_dict() for f in self.findings],
+            "stale_baseline": self.stale_baseline,
+            "unused_suppressions": self.unused_suppressions,
+            "timings": {
+                "index_seconds": round(self.index_seconds, 4),
+                "rules_seconds": round(self.rules_seconds, 4),
+                **self.index_stats,
+            },
         }
 
 
@@ -347,7 +420,16 @@ def lint_paths(
     baseline: Baseline | None = None,
     rule_ids: Iterable[str] | None = None,
     on_file: Callable[[str], None] | None = None,
+    cache_path: str | None = None,
 ) -> LintResult:
+    """Two-pass driver. Pass 1 builds the whole-program
+    :class:`~.project.ProjectIndex` over every file (reusing the mtime-keyed
+    on-disk summary cache — ``cache_path=''`` disables it); pass 2 runs the
+    per-file rules unchanged plus the :class:`ProjectRule`s against the
+    index. Suppression usage and baseline hit-counts are tracked so
+    ``--check-stale`` can report dead grandfathers and dead disables."""
+    from cst_captioning_tpu.tools.graftlint.project import ProjectIndex
+
     rules = all_rules()
     if rule_ids is not None:
         unknown = set(rule_ids) - set(rules)
@@ -355,14 +437,27 @@ def lint_paths(
             raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
         rules = {k: v for k, v in rules.items() if k in set(rule_ids)}
 
+    files = list(iter_py_files(paths))
+    t0 = time.perf_counter()
+    index = ProjectIndex.build(files, root, cache_path=cache_path)
+    index_seconds = time.perf_counter() - t0
+
     findings: list[Finding] = []
-    n_files = 0
-    for path in iter_py_files(paths):
-        n_files += 1
+    # (relpath, line) -> rule ids whose suppression actually fired there
+    used_supp: dict[tuple[str, int], set[str]] = {}
+    all_supp: list[tuple[str, int, set[str]]] = []
+    t0 = time.perf_counter()
+    for path in files:
         if on_file is not None:
             on_file(path)
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        pre = index.parsed.get(relpath)
         try:
-            ctx = FileContext.parse(path, root)
+            ctx = FileContext.parse(
+                path, root,
+                source=pre[0] if pre else None,
+                tree=pre[1] if pre else None,
+            )
         except SyntaxError as e:
             findings.append(Finding(
                 rule=PARSE_ERROR_RULE,
@@ -374,14 +469,54 @@ def lint_paths(
                 context="",
             ))
             continue
+        for line, ids in ctx.suppressions.items():
+            all_supp.append((ctx.relpath, line, ids))
         for rule in rules.values():
             if not rule.applies(ctx):
                 continue
-            for f in rule.check(ctx):
-                if not ctx.suppressed(f):
+            if isinstance(rule, ProjectRule):
+                checked = rule.check_project(ctx, index)
+            else:
+                checked = rule.check(ctx)
+            for f in checked:
+                if ctx.suppressed(f):
+                    used_supp.setdefault(
+                        (ctx.relpath, f.line), set()
+                    ).add(f.rule)
+                else:
                     findings.append(f)
+    rules_seconds = time.perf_counter() - t0
 
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    result = LintResult(
+        findings=findings,
+        files_checked=len(files),
+        index_seconds=index_seconds,
+        rules_seconds=rules_seconds,
+        index_stats=dataclasses.asdict(index.stats),
+    )
     if baseline is not None:
         baseline.apply(findings)
-    return LintResult(findings=findings, files_checked=n_files)
+        result.stale_baseline = baseline.stale_entries(findings)
+    # an "unused" suppression id is only meaningful when its rule ran
+    ran = set(rules)
+    for relpath, line, ids in sorted(all_supp):
+        hit = used_supp.get((relpath, line), set())
+        for rid in sorted(ids):
+            if rid == "all":
+                if not hit:
+                    result.unused_suppressions.append(
+                        {"path": relpath, "line": line, "rule": "all"}
+                    )
+            elif rid in ran:
+                if rid not in hit:
+                    result.unused_suppressions.append(
+                        {"path": relpath, "line": line, "rule": rid}
+                    )
+            elif rule_ids is None:
+                # not a registered rule id at all: a typo'd disable that
+                # can never suppress anything
+                result.unused_suppressions.append(
+                    {"path": relpath, "line": line, "rule": rid}
+                )
+    return result

@@ -1,0 +1,757 @@
+"""graftlint whole-program pass: the project index.
+
+Pass 1 of the two-pass engine (see :mod:`core`): every module under the lint
+paths is summarized into a :class:`ProjectIndex` — a project-wide symbol
+table that the interprocedural ``ProjectRule``s (GL013/GL014/GL015) and the
+mesh-aware per-file rules (GL012, GL007) query during pass 2.
+
+What the index knows:
+
+- **modules** — dotted-name → :class:`ModuleSummary` (path, import-alias
+  map, function table), with suffix-based lookup so both package-absolute
+  (``cst_captioning_tpu.rl.scst.rollout``) and fixture-local (``producer.f``)
+  callee names resolve.
+- **function summaries** — per top-level function/method: which parameters
+  are consumed as PRNG keys (directly or transitively through callees),
+  whether the return value's provenance traces to device arrays (jnp/lax/
+  random producers, traced functions, device-returning callees — resolved
+  by a global fixpoint over the call graph), and whether a generator yields
+  device-placed values (the ``prefetch_to_device`` pattern: stages via
+  ``jax.device_put``, then yields).
+- **mesh declaration** — the axes and PARAM_PARTITION_RULES families
+  declared by ``<root>/cst_captioning_tpu/train/mesh.py``, scraped once per
+  run (GL012's old module-level cache is gone: a long-lived test session
+  re-scrapes whenever the index is rebuilt, and the on-disk cache below is
+  mtime-keyed).
+- **on-disk summary cache** — ``<root>/.graftlint_cache.json`` keyed by
+  ``(mtime, size)`` per file, so repeat ``lint.sh`` runs skip re-parsing
+  unchanged modules in pass 1. Summaries are cached PRE-fixpoint; the
+  cross-module fixpoint is recomputed every run (it is global and cheap).
+
+Everything here is stdlib-``ast`` only — no JAX import, no backend init.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+
+# ---- shared AST helpers (canonical home; rules.py re-exports) ---------------
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# call-position names that trace their function arguments into XLA programs
+_TRACERS = {
+    "jit", "pjit", "shard_map", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "vmap", "pmap", "grad", "value_and_grad", "vjp", "jvp",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "associative_scan",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.lax.scan' for a Name/Attribute chain, '' when not one."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _last(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    """True for @jax.jit / @pjit / @functools.partial(jax.jit, ...) style."""
+    if isinstance(dec, ast.Call):
+        d = _dotted(dec.func)
+        if _last(d) == "partial" and dec.args:
+            return _last(_dotted(dec.args[0])) in _TRACERS
+        return _last(d) in _TRACERS
+    return _last(_dotted(dec)) in _TRACERS
+
+
+# dotted-prefix bases whose call results live on device
+_DEVICE_BASES = (
+    "jax.numpy.", "jax.lax.", "jax.random.", "jax.nn.", "jax.scipy.",
+)
+_DEVICE_EXACT = {"jax.device_put", "jax.make_array_from_process_local_data"}
+# results known to be HOST values (the explicit-readback spelling)
+_HOST_EXACT = {"jax.device_get", "float", "int", "len", "str", "bool"}
+_HOST_BASES = ("numpy.",)
+
+# jax.random consumers: a key passed here is spent
+_KEY_CONSUMERS = {
+    "categorical", "normal", "uniform", "bernoulli", "gumbel", "choice",
+    "permutation", "randint", "bits", "exponential", "laplace",
+    "truncated_normal", "dirichlet", "beta", "gamma", "poisson", "shuffle",
+}
+
+
+def module_name_for(relpath: str) -> str:
+    """Dotted module name for a repo-relative posix path."""
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+def import_aliases(tree: ast.Module, module_name: str) -> dict[str, str]:
+    """Local name -> canonical dotted target, from the module's imports.
+
+    ``import numpy as np`` -> ``{'np': 'numpy'}``; ``from jax.sharding
+    import PartitionSpec as P`` -> ``{'P': 'jax.sharding.PartitionSpec'}``;
+    relative imports resolve against ``module_name``'s package.
+    """
+    pkg = module_name.split(".")[:-1]
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    top = a.name.split(".", 1)[0]
+                    out[top] = top
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base_parts = pkg[: len(pkg) - (node.level - 1)]
+                base = ".".join(
+                    base_parts + ([node.module] if node.module else [])
+                )
+            else:
+                base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                target = f"{base}.{a.name}" if base else a.name
+                out[a.asname or a.name] = target
+    return out
+
+
+def resolve_dotted(dotted: str, aliases: dict[str, str]) -> str:
+    """Expand the first segment of a dotted name through the alias map."""
+    if not dotted:
+        return dotted
+    first, _, rest = dotted.partition(".")
+    base = aliases.get(first)
+    if base is None:
+        return dotted
+    return f"{base}.{rest}" if rest else base
+
+
+# ---- per-function summaries -------------------------------------------------
+
+@dataclass
+class CallSite:
+    """One call to a (possibly cross-module) function, with the caller
+    params forwarded at each argument position — the call-graph edge."""
+
+    callee: str                      # resolved dotted name
+    lineno: int
+    arg_params: list[str | None] = field(default_factory=list)
+    kw_params: dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CallSite":
+        return cls(**d)
+
+
+@dataclass
+class FunctionSummary:
+    """What callers may rely on about one function, without reading it."""
+
+    qualname: str                    # module-relative, e.g. "Trainer.fit"
+    lineno: int
+    params: list[str] = field(default_factory=list)
+    # params consumed directly as PRNG keys (arg0 / key= of a jax.random
+    # consumer) — transitive consumption is added by the index fixpoint
+    key_params_consumed: list[str] = field(default_factory=list)
+    # where each consumed param is spent: param -> "jax.random.normal" or
+    # (post-fixpoint) the consuming callee's dotted name
+    key_consumed_via: dict[str, str] = field(default_factory=dict)
+    returns_device: bool = False
+    device_reason: str = ""          # human chain: why the return is device
+    yields_device: bool = False
+    traced: bool = False             # jit/pjit-decorated
+    # callees whose result this function returns (pre-fixpoint pending set)
+    returns_calls: list[str] = field(default_factory=list)
+    calls: list[CallSite] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["calls"] = [c.to_dict() for c in self.calls]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FunctionSummary":
+        d = dict(d)
+        d["calls"] = [CallSite.from_dict(c) for c in d.get("calls", [])]
+        return cls(**d)
+
+
+@dataclass
+class ModuleSummary:
+    module: str                      # dotted name
+    relpath: str
+    mtime: float = 0.0
+    size: int = 0
+    aliases: dict[str, str] = field(default_factory=dict)
+    functions: dict[str, FunctionSummary] = field(default_factory=dict)
+    parse_error: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "module": self.module,
+            "relpath": self.relpath,
+            "mtime": self.mtime,
+            "size": self.size,
+            "aliases": self.aliases,
+            "functions": {
+                k: f.to_dict() for k, f in self.functions.items()
+            },
+            "parse_error": self.parse_error,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModuleSummary":
+        out = cls(
+            module=d["module"], relpath=d["relpath"],
+            mtime=d.get("mtime", 0.0), size=d.get("size", 0),
+            aliases=dict(d.get("aliases", {})),
+            parse_error=bool(d.get("parse_error", False)),
+        )
+        out.functions = {
+            k: FunctionSummary.from_dict(f)
+            for k, f in d.get("functions", {}).items()
+        }
+        return out
+
+
+class _FunctionSummarizer:
+    """Single in-order walk of one function body (nested defs excluded:
+    they are separate scopes, summarized — when top-level — on their own)."""
+
+    def __init__(self, fn: ast.AST, qualname: str, aliases: dict[str, str]):
+        self.fn = fn
+        self.aliases = aliases
+        args = fn.args
+        params = [a.arg for a in args.posonlyargs + args.args
+                  + args.kwonlyargs]
+        if args.vararg:
+            params.append(args.vararg.arg)
+        if args.kwarg:
+            params.append(args.kwarg.arg)
+        self.summary = FunctionSummary(
+            qualname=qualname, lineno=fn.lineno, params=params,
+            traced=any(_decorator_traces(d) for d in fn.decorator_list),
+        )
+        # local provenance: name -> reason string ("" = device, why)
+        self.device_vars: dict[str, str] = {}
+        # name -> pending callee (result of an unresolved call)
+        self.pending_vars: dict[str, str] = {}
+        self.has_device_put = False
+        self.yields_any = False
+
+    def run(self) -> FunctionSummary:
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+        if self.summary.traced:
+            self.summary.returns_device = True
+            self.summary.device_reason = "jit-traced function"
+        if self.yields_any and self.has_device_put and not \
+                self.summary.yields_device:
+            # the prefetch pattern: stages via device_put, yields the result
+            # through a queue the walker cannot see through
+            self.summary.yields_device = True
+            self.summary.device_reason = (
+                self.summary.device_reason
+                or "generator stages values via jax.device_put"
+            )
+        return self.summary
+
+    # -- statement walk, in source order --------------------------------
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, _FUNC_NODES + (ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Assign):
+            self._visit_expr(node.value)
+            self._bind(node.targets, node.value)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if node.value is not None:
+                self._visit_expr(node.value)
+                self._bind([node.target], node.value)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            self._visit_expr(node.value)
+            self._note_return(node.value)
+        elif isinstance(node, ast.Expr):
+            self._visit_expr(node.value)
+        elif isinstance(node, ast.For):
+            self._visit_expr(node.iter)
+            for stmt in node.body + node.orelse:
+                self._stmt(stmt)
+            return
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._visit_expr(child)
+                else:
+                    self._stmt(child)
+            return
+
+    def _bind(self, targets: list[ast.AST], value: ast.AST) -> None:
+        names: list[str] = []
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    names.append(sub.id)
+        prov, reason, pending = self._provenance(value)
+        for n in names:
+            self.device_vars.pop(n, None)
+            self.pending_vars.pop(n, None)
+            if prov:
+                self.device_vars[n] = reason
+            elif pending:
+                self.pending_vars[n] = pending
+
+    def _note_return(self, expr: ast.AST) -> None:
+        prov, reason, pending = self._provenance(expr)
+        if prov and not self.summary.returns_device:
+            self.summary.returns_device = True
+            self.summary.device_reason = reason
+        elif pending and pending not in self.summary.returns_calls:
+            self.summary.returns_calls.append(pending)
+
+    # -- expression analysis --------------------------------------------
+
+    def _visit_expr(self, expr: ast.AST) -> None:
+        """Record key consumption, call-graph edges, and yields inside an
+        expression (single traversal)."""
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Yield, ast.YieldFrom)):
+                self.yields_any = True
+                if isinstance(node, ast.Yield) and node.value is not None:
+                    prov, reason, _ = self._provenance(node.value)
+                    if prov:
+                        self.summary.yields_device = True
+                        self.summary.device_reason = reason
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = resolve_dotted(_dotted(node.func), self.aliases)
+            if resolved in ("jax.device_put",):
+                self.has_device_put = True
+            base, _, attr = resolved.rpartition(".")
+            if base == "jax.random" and attr in _KEY_CONSUMERS:
+                key_arg = node.args[0] if node.args else None
+                for kw in node.keywords:
+                    if kw.arg == "key":
+                        key_arg = kw.value
+                if isinstance(key_arg, ast.Name) and \
+                        key_arg.id in self.summary.params:
+                    if key_arg.id not in self.summary.key_params_consumed:
+                        self.summary.key_params_consumed.append(key_arg.id)
+                        self.summary.key_consumed_via[key_arg.id] = resolved
+            elif resolved and not resolved.startswith(("jax.", "numpy.")):
+                # a call-graph edge for a possibly-indexed callee; param-
+                # level arg forwarding recorded for the key fixpoint
+                arg_params = [
+                    a.id if isinstance(a, ast.Name)
+                    and a.id in self.summary.params else None
+                    for a in node.args
+                ]
+                kw_params = {
+                    kw.arg: kw.value.id for kw in node.keywords
+                    if kw.arg and isinstance(kw.value, ast.Name)
+                    and kw.value.id in self.summary.params
+                }
+                if any(p for p in arg_params) or kw_params:
+                    self.summary.calls.append(CallSite(
+                        callee=resolved, lineno=node.lineno,
+                        arg_params=arg_params, kw_params=kw_params,
+                    ))
+
+    def _provenance(self, expr: ast.AST) -> tuple[bool, str, str]:
+        """-> (is_device, reason, pending_callee). Conservative: params and
+        unknown expressions have no provenance (never guess)."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.device_vars:
+                return True, self.device_vars[expr.id], ""
+            if expr.id in self.pending_vars:
+                return False, "", self.pending_vars[expr.id]
+            return False, "", ""
+        if isinstance(expr, (ast.Attribute, ast.Subscript)):
+            # a field/slice of a device value is a device value
+            prov, reason, pending = self._provenance(expr.value)
+            return prov, reason, pending
+        if isinstance(expr, ast.Call):
+            # jax.jit(f)(x)-style: the inner call's last segment is a tracer
+            if isinstance(expr.func, ast.Call):
+                inner = resolve_dotted(_dotted(expr.func.func), self.aliases)
+                if _last(inner) in _TRACERS:
+                    return True, f"result of {inner}(...)", ""
+            resolved = resolve_dotted(_dotted(expr.func), self.aliases)
+            if not resolved:
+                return False, "", ""
+            if resolved in _HOST_EXACT or resolved.startswith(_HOST_BASES):
+                return False, "", ""
+            if resolved in _DEVICE_EXACT or \
+                    resolved.startswith(_DEVICE_BASES):
+                return True, f"result of {resolved}(...)", ""
+            if resolved.startswith("jax."):
+                return False, "", ""
+            return False, "", resolved  # pending on an indexed callee
+        if isinstance(expr, ast.BinOp):
+            sides = (expr.left, expr.right)
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            sides = tuple(expr.elts)
+        elif isinstance(expr, ast.IfExp):
+            sides = (expr.body, expr.orelse)
+        else:
+            return False, "", ""
+        first_pending = ""
+        for side in sides:
+            prov, reason, pending = self._provenance(side)
+            if prov:
+                return True, reason, ""
+            if pending and not first_pending:
+                first_pending = pending
+        return False, "", first_pending
+
+
+def summarize_module(tree: ast.Module, relpath: str) -> ModuleSummary:
+    """Pass-1 summary of one parsed module (pure function of the AST)."""
+    module = module_name_for(relpath)
+    aliases = import_aliases(tree, module)
+    out = ModuleSummary(module=module, relpath=relpath, aliases=aliases)
+
+    def visit(body: list[ast.stmt], prefix: str) -> None:
+        for node in body:
+            if isinstance(node, _FUNC_NODES):
+                qual = f"{prefix}{node.name}"
+                out.functions[qual] = _FunctionSummarizer(
+                    node, qual, aliases
+                ).run()
+            elif isinstance(node, ast.ClassDef):
+                visit(node.body, f"{prefix}{node.name}.")
+
+    visit(tree.body, "")
+    return out
+
+
+# ---- mesh declaration (GL012/GL015/GL007 shared scrape) ---------------------
+
+@dataclass
+class MeshDecl:
+    """What train/mesh.py declares: the single source of truth the
+    sharding-surface rules check literals against."""
+
+    axes: frozenset = frozenset({"data", "seq"})
+    families: tuple = ()             # ((family, regex), ...)
+    contract: str = ""               # SHARDING_CONTRACT value, if declared
+    found: bool = False
+
+    def to_dict(self) -> dict:
+        return {"axes": sorted(self.axes), "families": list(self.families),
+                "contract": self.contract, "found": self.found}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MeshDecl":
+        return cls(
+            axes=frozenset(d.get("axes", ("data", "seq"))),
+            families=tuple(tuple(f) for f in d.get("families", ())),
+            contract=d.get("contract", ""), found=bool(d.get("found")),
+        )
+
+
+MESH_RELPATH = "cst_captioning_tpu/train/mesh.py"
+
+
+def scrape_mesh_decl(tree: ast.Module) -> MeshDecl:
+    """Mesh axes (string defaults of ``*axis`` function parameters),
+    PARAM_PARTITION_RULES families, and the SHARDING_CONTRACT path."""
+    axes: set[str] = set()
+    families: list[tuple[str, str]] = []
+    contract = ""
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES):
+            args = node.args
+            pos = args.posonlyargs + args.args
+            pairs = list(
+                zip(pos[len(pos) - len(args.defaults):], args.defaults)
+            ) + [
+                (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                if d is not None
+            ]
+            for arg, default in pairs:
+                if arg.arg.endswith("axis") and isinstance(
+                    default, ast.Constant
+                ) and isinstance(default.value, str) and default.value:
+                    axes.add(default.value)
+        elif isinstance(node, ast.Assign):
+            names = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if "PARAM_PARTITION_RULES" in names:
+                for elt in getattr(node.value, "elts", []):
+                    parts = getattr(elt, "elts", [])
+                    if len(parts) >= 2 and isinstance(
+                        parts[0], ast.Constant
+                    ) and isinstance(parts[1], ast.Constant):
+                        families.append(
+                            (str(parts[0].value), str(parts[1].value))
+                        )
+            if "SHARDING_CONTRACT" in names and isinstance(
+                node.value, ast.Constant
+            ):
+                contract = str(node.value.value)
+    return MeshDecl(
+        axes=frozenset(axes) if axes else MeshDecl.axes,
+        families=tuple(families), contract=contract, found=True,
+    )
+
+
+# ---- the index --------------------------------------------------------------
+
+CACHE_NAME = ".graftlint_cache.json"
+_CACHE_VERSION = 2
+_FIXPOINT_MAX_ROUNDS = 25
+
+
+@dataclass
+class IndexStats:
+    files: int = 0
+    summarized: int = 0
+    cached: int = 0
+
+
+class ProjectIndex:
+    """Project-wide symbol table + call-graph summaries (pass 1)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.modules: dict[str, ModuleSummary] = {}      # dotted name ->
+        self.by_relpath: dict[str, ModuleSummary] = {}
+        self.mesh = MeshDecl()
+        self.stats = IndexStats()
+        # dotted function name ("<module>.<qual>") -> summary
+        self.functions: dict[str, FunctionSummary] = {}
+        self._suffix_cache: dict[str, str | None] = {}
+        # (source, tree) for files parsed THIS run (cache misses): pass 2
+        # adopts them instead of re-parsing
+        self.parsed: dict[str, tuple[str, ast.Module]] = {}
+
+    # -- build ----------------------------------------------------------
+
+    @classmethod
+    def build(cls, files: list[str], root: str,
+              cache_path: str | None = None) -> "ProjectIndex":
+        """Summarize ``files`` (absolute paths) under ``root``; reuse the
+        mtime-keyed on-disk cache at ``cache_path`` (default
+        ``<root>/.graftlint_cache.json``; pass '' to disable caching)."""
+        index = cls(root)
+        if cache_path is None:
+            cache_path = os.path.join(index.root, CACHE_NAME)
+        cache = _load_cache(cache_path) if cache_path else {}
+        entries = cache.get("files", {})
+        dirty = False
+
+        mesh_path = os.path.join(index.root, MESH_RELPATH)
+        todo = list(files)
+        if os.path.exists(mesh_path) and not any(
+            os.path.abspath(p) == mesh_path for p in todo
+        ):
+            todo.append(mesh_path)
+
+        for path in todo:
+            relpath = os.path.relpath(path, index.root).replace(os.sep, "/")
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            index.stats.files += 1
+            ent = entries.get(relpath)
+            if ent and ent.get("mtime") == st.st_mtime and \
+                    ent.get("size") == st.st_size:
+                summary = ModuleSummary.from_dict(ent["summary"])
+                mesh = MeshDecl.from_dict(ent["mesh"]) if "mesh" in ent \
+                    else None
+                index.stats.cached += 1
+            else:
+                summary, mesh, parsed = _summarize_path(path, relpath)
+                if parsed is not None:
+                    index.parsed[relpath] = parsed
+                entries[relpath] = {
+                    "mtime": st.st_mtime, "size": st.st_size,
+                    "summary": summary.to_dict(),
+                }
+                if mesh is not None:
+                    entries[relpath]["mesh"] = mesh.to_dict()
+                index.stats.summarized += 1
+                dirty = True
+            summary.mtime, summary.size = st.st_mtime, st.st_size
+            index.modules[summary.module] = summary
+            index.by_relpath[relpath] = summary
+            if relpath == MESH_RELPATH and mesh is not None:
+                index.mesh = mesh
+
+        for module in index.modules.values():
+            for qual, fn in module.functions.items():
+                index.functions[f"{module.module}.{qual}"] = fn
+        index._fixpoint()
+        if cache_path and dirty:
+            _save_cache(cache_path, {"version": _CACHE_VERSION,
+                                     "files": entries})
+        return index
+
+    # -- lookups --------------------------------------------------------
+
+    def lookup(self, dotted: str) -> tuple[str, FunctionSummary] | None:
+        """Resolve an already-alias-expanded dotted callee name to its
+        (full indexed name, summary).
+
+        Exact match first, then a unique-suffix match (fixture-local flat
+        imports: ``producer.f`` matches ``tests.fixtures.….producer.f``);
+        ambiguous suffixes resolve to nothing — never guess.
+        """
+        if not dotted:
+            return None
+        hit = self.functions.get(dotted)
+        if hit is not None:
+            return dotted, hit
+        if dotted not in self._suffix_cache:
+            suffix = "." + dotted
+            matches = [k for k in self.functions if k.endswith(suffix)]
+            self._suffix_cache[dotted] = (
+                matches[0] if len(matches) == 1 else None
+            )
+        key = self._suffix_cache[dotted]
+        return (key, self.functions[key]) if key else None
+
+    def lookup_function(self, dotted: str) -> FunctionSummary | None:
+        hit = self.lookup(dotted)
+        return hit[1] if hit else None
+
+    def lookup_from(self, module: str,
+                    dotted: str) -> tuple[str, FunctionSummary] | None:
+        """Like :meth:`lookup`, but same-module names win first: a bare
+        local call (``decode(...)``) must resolve to THIS module's def,
+        never suffix-match a same-named function elsewhere."""
+        if module and dotted:
+            local = f"{module}.{dotted}"
+            hit = self.functions.get(local)
+            if hit is not None:
+                return local, hit
+        return self.lookup(dotted)
+
+    def module_of(self, relpath: str) -> str:
+        mod = self.by_relpath.get(relpath)
+        return mod.module if mod is not None else module_name_for(relpath)
+
+    def aliases_for(self, relpath: str, tree: ast.Module) -> dict[str, str]:
+        """Import-alias map for a file — from its module summary when the
+        file was indexed, recomputed from ``tree`` otherwise."""
+        mod = self.by_relpath.get(relpath)
+        if mod is not None and not mod.parse_error:
+            return mod.aliases
+        return import_aliases(tree, module_name_for(relpath))
+
+    # -- cross-module fixpoint ------------------------------------------
+
+    def _fixpoint(self) -> None:
+        """Propagate device-return provenance and PRNG-key consumption
+        through the call graph until stable."""
+        owner_module = {
+            f"{m.module}.{qual}": m.module
+            for m in self.modules.values() for qual in m.functions
+        }
+        for _ in range(_FIXPOINT_MAX_ROUNDS):
+            changed = False
+            for name, fn in self.functions.items():
+                mod = owner_module.get(name, "")
+                # returns_device via a returned callee result
+                if not fn.returns_device:
+                    for callee in fn.returns_calls:
+                        hit = self.lookup_from(mod, callee)
+                        target = hit[1] if hit else None
+                        if target is not None and target.returns_device:
+                            fn.returns_device = True
+                            fn.device_reason = (
+                                f"returns {callee}(...) → "
+                                f"{target.device_reason or 'device value'}"
+                            )
+                            changed = True
+                            break
+                # transitive key consumption through consuming callees
+                for site in fn.calls:
+                    hit = self.lookup_from(mod, site.callee)
+                    target = hit[1] if hit else None
+                    if target is None or not target.key_params_consumed:
+                        continue
+                    for i, p in enumerate(site.arg_params):
+                        if p is None or p in fn.key_params_consumed:
+                            continue
+                        if i < len(target.params) and \
+                                target.params[i] in \
+                                target.key_params_consumed:
+                            fn.key_params_consumed.append(p)
+                            fn.key_consumed_via[p] = site.callee
+                            changed = True
+                    for kw, p in site.kw_params.items():
+                        if p in fn.key_params_consumed:
+                            continue
+                        if kw in target.key_params_consumed:
+                            fn.key_params_consumed.append(p)
+                            fn.key_consumed_via[p] = site.callee
+                            changed = True
+            if not changed:
+                return
+
+
+def _summarize_path(
+    path: str, relpath: str
+) -> tuple[ModuleSummary, MeshDecl | None, tuple[str, ast.Module] | None]:
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=relpath)
+    except (OSError, SyntaxError):
+        return ModuleSummary(
+            module=module_name_for(relpath), relpath=relpath,
+            parse_error=True,
+        ), None, None
+    summary = summarize_module(tree, relpath)
+    mesh = scrape_mesh_decl(tree) if relpath == MESH_RELPATH else None
+    return summary, mesh, (source, tree)
+
+
+def _load_cache(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if isinstance(data, dict) and data.get("version") == _CACHE_VERSION:
+            return data
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
+def _save_cache(path: str, data: dict) -> None:
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # caching is best-effort; never fail the lint over it

@@ -17,18 +17,21 @@ import re
 from cst_captioning_tpu.tools.graftlint.core import (
     FileContext,
     Finding,
+    ProjectRule,
     Rule,
     register,
 )
+from cst_captioning_tpu.tools.graftlint.project import (
+    _FUNC_NODES,
+    _TRACERS,
+    _decorator_traces,
+    _dotted,
+    _last,
+    ProjectIndex,
+    resolve_dotted,
+)
 
-# ---- shared AST helpers -----------------------------------------------------
-
-# call-position names that trace their function arguments into XLA programs
-_TRACERS = {
-    "jit", "pjit", "shard_map", "scan", "while_loop", "fori_loop", "cond",
-    "switch", "vmap", "pmap", "grad", "value_and_grad", "vjp", "jvp",
-    "checkpoint", "remat", "custom_vjp", "custom_jvp", "associative_scan",
-}
+# ---- shared AST helpers (canonical defs live in project.py) -----------------
 
 _HOT_PACKAGES = (
     "cst_captioning_tpu/train/", "cst_captioning_tpu/rl/",
@@ -36,38 +39,9 @@ _HOT_PACKAGES = (
 )
 
 
-def _dotted(node: ast.AST) -> str:
-    """'jax.lax.scan' for a Name/Attribute chain, '' when not one."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
-
-
-def _last(dotted: str) -> str:
-    return dotted.rsplit(".", 1)[-1] if dotted else ""
-
-
-_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
-
-
 def _is_tracer_call(call: ast.Call) -> bool:
     d = _dotted(call.func)
     return bool(d) and not d.startswith(("self.", "cls.")) and _last(d) in _TRACERS
-
-
-def _decorator_traces(dec: ast.AST) -> bool:
-    """True for @jax.jit / @pjit / @functools.partial(jax.jit, ...) style."""
-    if isinstance(dec, ast.Call):
-        d = _dotted(dec.func)
-        if _last(d) == "partial" and dec.args:
-            return _last(_dotted(dec.args[0])) in _TRACERS
-        return _last(d) in _TRACERS
-    return _last(_dotted(dec)) in _TRACERS
 
 
 def traced_node_ids(ctx: FileContext) -> set[int]:
@@ -84,12 +58,12 @@ def traced_node_ids(ctx: FileContext) -> set[int]:
         return cached
 
     name_defs: dict[str, list[ast.AST]] = {}
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk_nodes():
         if isinstance(node, _FUNC_NODES):
             name_defs.setdefault(node.name, []).append(node)
 
     entries: list[ast.AST] = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk_nodes():
         if isinstance(node, _FUNC_NODES) and any(
             _decorator_traces(d) for d in node.decorator_list
         ):
@@ -156,7 +130,7 @@ class HostSyncRule(Rule):
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
         traced = traced_node_ids(ctx)
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             prim = _sync_call(node)
             if prim and id(node) in traced:
                 out.append(ctx.finding(
@@ -186,7 +160,7 @@ class HostSyncRule(Rule):
             or isinstance(n, ast.ImportFrom) and (n.module or "").split(
                 "."
             )[0] == "jax"
-            for n in ast.walk(ctx.tree)
+            for n in ctx.walk_nodes()
         )
 
     def _check_step_loops(self, ctx: FileContext,
@@ -195,7 +169,7 @@ class HostSyncRule(Rule):
         statements and `if` tests, but not gated `if` bodies (logging every N
         steps is a deliberate, amortized sync)."""
         out: dict[tuple[int, int, str], Finding] = {}
-        for loop in ast.walk(ctx.tree):
+        for loop in ctx.walk_nodes():
             if not isinstance(loop, (ast.For, ast.While)):
                 continue
             if id(loop) in traced:
@@ -267,7 +241,7 @@ class KeyReuseRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             if isinstance(node, _FUNC_NODES):
                 out.extend(self._check_function(ctx, node))
         return out
@@ -370,7 +344,7 @@ class TracedBranchRule(Rule):
     def check(self, ctx: FileContext) -> list[Finding]:
         traced = traced_node_ids(ctx)
         out: list[Finding] = []
-        for fn in ast.walk(ctx.tree):
+        for fn in ctx.walk_nodes():
             if not isinstance(fn, _FUNC_NODES) or id(fn) not in traced:
                 continue
             tensor_names: set[str] = set()
@@ -439,7 +413,7 @@ class DonationRule(Rule):
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
         enclosing = _enclosing_function_names(ctx)
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             if isinstance(node, _FUNC_NODES):
                 # a *train* step carries mutable state (params/optimizer);
                 # decode/eval "step" functions don't, and donating their
@@ -543,7 +517,7 @@ class F32LiteralRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             if not isinstance(node, ast.Call):
                 continue
             d = _dotted(node.func)
@@ -606,7 +580,7 @@ class HeavyImportRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             mods: list[str] = []
             if isinstance(node, ast.Import):
                 mods = [a.name for a in node.names]
@@ -663,6 +637,12 @@ def _is_main_guard(stmt: ast.AST) -> bool:
 
 @register
 class PartitionCoverageRule(Rule):
+    """Anchors findings on the PARAM_PARTITION_RULES tuple of the file
+    being linted, so it parses the families from ``ctx.tree`` itself; the
+    project index carries the same declaration (``index.mesh.families``,
+    via :func:`~.project.scrape_mesh_decl`) for rules that need it without
+    node anchors (GL012/GL015 use the axes half)."""
+
     id = "GL007"
     name = "partition-rule-coverage"
     severity = "error"
@@ -782,7 +762,7 @@ class TpuTestMarkerRule(Rule):
     def check(self, ctx: FileContext) -> list[Finding]:
         tpu_import = None
         tpu_mod = ""
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             mods: list[str] = []
             if isinstance(node, ast.Import):
                 mods = [a.name for a in node.names]
@@ -800,7 +780,7 @@ class TpuTestMarkerRule(Rule):
         if self._module_marked_slow(ctx.tree):
             return []
         unmarked = [
-            fn.name for fn in ast.walk(ctx.tree)
+            fn.name for fn in ctx.walk_nodes()
             if isinstance(fn, _FUNC_NODES) and fn.name.startswith("test_")
             and not self._marked_slow(fn)
         ]
@@ -855,7 +835,7 @@ class SwallowedExceptionRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             if not isinstance(node, ast.Try):
                 continue
             for handler in node.handlers:
@@ -923,7 +903,7 @@ class AdHocTimingRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             if not isinstance(node, ast.Call):
                 continue
             d = _dotted(node.func)
@@ -976,7 +956,7 @@ class ScanCarryDtypeRule(Rule):
         out: list[Finding] = []
         defs: dict[str, ast.AST] = {}
         assigns: dict[str, ast.AST] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             if isinstance(node, _FUNC_NODES):
                 defs[node.name] = node
             elif isinstance(node, ast.Assign) and len(node.targets) == 1:
@@ -985,7 +965,7 @@ class ScanCarryDtypeRule(Rule):
                     # last write wins — good enough for the literal inits
                     # this rule reasons about
                     assigns[tgt.id] = node.value
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk_nodes():
             if not isinstance(node, ast.Call):
                 continue
             kind = _last(_dotted(node.func))
@@ -1103,55 +1083,9 @@ _GL012_COLLECTIVES = {
 }
 _GL012_AXIS_KWARGS = ("axis_name",)
 
-# module-level cache: (root, mesh.py mtime) -> declared axis names
-_GL012_AXES_CACHE: dict = {}
-
-
-def _declared_mesh_axes(root: str) -> frozenset:
-    """Mesh axis names declared by ``train/mesh.py``: the string defaults of
-    every ``*axis``-named function parameter (``make_mesh(axis='data',
-    seq_axis='seq')`` is the declaration site). Falls back to the historical
-    ``{'data', 'seq'}`` when the file is missing or declares nothing."""
-    path = os.path.join(root, "cst_captioning_tpu", "train", "mesh.py")
-    try:
-        mtime = os.path.getmtime(path)
-    except OSError:
-        mtime = None
-    key = (os.path.abspath(root), mtime)
-    cached = _GL012_AXES_CACHE.get(key)
-    if cached is not None:
-        return cached
-    axes: set[str] = set()
-    if mtime is not None:
-        try:
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read())
-        except (OSError, SyntaxError):
-            tree = None
-        if tree is not None:
-            for node in ast.walk(tree):
-                if not isinstance(node, _FUNC_NODES):
-                    continue
-                args = node.args
-                pos = args.posonlyargs + args.args
-                pairs = list(
-                    zip(pos[len(pos) - len(args.defaults):], args.defaults)
-                ) + [
-                    (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
-                    if d is not None
-                ]
-                for arg, default in pairs:
-                    if arg.arg.endswith("axis") and isinstance(
-                        default, ast.Constant
-                    ) and isinstance(default.value, str) and default.value:
-                        axes.add(default.value)
-    out = frozenset(axes) if axes else frozenset({"data", "seq"})
-    _GL012_AXES_CACHE[key] = out
-    return out
-
 
 @register
-class CollectiveAxisRule(Rule):
+class CollectiveAxisRule(ProjectRule):
     id = "GL012"
     name = "collective-axis-name-typo"
     severity = "error"
@@ -1166,10 +1100,14 @@ class CollectiveAxisRule(Rule):
         # package code only: tests/fixtures spell fake axes on purpose
         return _in_package(ctx)
 
-    def check(self, ctx: FileContext) -> list[Finding]:
+    def check_project(self, ctx: FileContext,
+                      index: ProjectIndex) -> list[Finding]:
+        # the mesh-axes scrape lives on the project index now: rebuilt
+        # whenever mesh.py's (mtime, size) changes, so a long-lived test
+        # session can never lint against stale axes
         out: list[Finding] = []
-        allowed = _declared_mesh_axes(ctx.root)
-        for node in ast.walk(ctx.tree):
+        allowed = index.mesh.axes
+        for node in ctx.walk_nodes():
             if not isinstance(node, ast.Call):
                 continue
             name = _last(_dotted(node.func))
@@ -1208,3 +1146,486 @@ class CollectiveAxisRule(Rule):
                 if isinstance(e, ast.Constant) and isinstance(e.value, str)
             ]
         return []
+
+
+# ---- GL013: implicit host transfers on device-provenance values -------------
+
+# numpy calls that force a device->host transfer when handed a device array
+_GL013_NP_SINKS = {
+    "asarray", "array", "ascontiguousarray", "copy", "mean", "sum", "max",
+    "min", "abs", "concatenate", "stack", "vstack", "hstack", "where",
+    "argmax", "argmin", "argsort", "sort", "unique", "square", "sqrt",
+    "clip", "dot", "einsum", "std", "var", "median", "prod", "all", "any",
+    "allclose", "array_equal", "count_nonzero", "save", "savez",
+}
+# jnp re-wraps of an already-device value: at best a no-op, at worst a
+# hidden dtype-cast copy — and historically the spelling that smuggled a
+# per-step host re-wrap of prefetched batches into the hot loop
+_GL013_JNP_SINKS = {"jax.numpy.asarray", "jax.numpy.array"}
+
+_GL013_EXCLUDED = ("cst_captioning_tpu/tools/",)
+
+
+class _DeviceFlow:
+    """In-order local dataflow over one function body (pass 2 of GL013):
+    tracks which names hold device-resident values and the interprocedural
+    path that made them so, querying the project index for callee return
+    provenance and device-yielding generators."""
+
+    def __init__(self, rule: "ImplicitTransferRule", ctx: FileContext,
+                 index: ProjectIndex, aliases: dict[str, str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.index = index
+        self.aliases = aliases
+        self.module = index.module_of(ctx.relpath)
+        self.device_vars: dict[str, str] = {}   # name -> provenance chain
+        self.findings: list[Finding] = []
+        self._reported: set[tuple[int, int]] = set()
+
+    # -- provenance ------------------------------------------------------
+
+    def provenance(self, expr: ast.AST) -> str | None:
+        """Why ``expr`` is device-resident (a human-readable chain), or
+        None when its provenance is unknown — never guess."""
+        if isinstance(expr, ast.Name):
+            return self.device_vars.get(expr.id)
+        if isinstance(expr, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self.provenance(expr.value)
+        if isinstance(expr, ast.Call):
+            if isinstance(expr.func, ast.Call):
+                inner = resolve_dotted(
+                    _dotted(expr.func.func), self.aliases
+                )
+                if _last(inner) in _TRACERS:
+                    return f"result of {inner}(...)"
+            resolved = resolve_dotted(_dotted(expr.func), self.aliases)
+            if not resolved:
+                return None
+            from cst_captioning_tpu.tools.graftlint.project import (
+                _DEVICE_BASES, _DEVICE_EXACT, _HOST_BASES, _HOST_EXACT,
+            )
+            if resolved in _HOST_EXACT or resolved.startswith(_HOST_BASES):
+                return None
+            if resolved in _DEVICE_EXACT or \
+                    resolved.startswith(_DEVICE_BASES):
+                return f"result of {resolved}(...)"
+            if resolved.startswith("jax."):
+                return None
+            hit = self.index.lookup_from(self.module, resolved)
+            if hit is not None and hit[1].returns_device:
+                name, summary = hit
+                return f"returns from {name}() [{summary.device_reason}]"
+            return None
+        if isinstance(expr, ast.BinOp):
+            return self.provenance(expr.left) or \
+                self.provenance(expr.right)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for elt in expr.elts:
+                chain = self.provenance(elt)
+                if chain:
+                    return chain
+            return None
+        if isinstance(expr, ast.IfExp):
+            return self.provenance(expr.body) or \
+                self.provenance(expr.orelse)
+        return None
+
+    # -- statement walk --------------------------------------------------
+
+    def run(self, body: list[ast.stmt]) -> list[Finding]:
+        for stmt in body:
+            self._stmt(stmt)
+        return self.findings
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, _FUNC_NODES + (ast.Lambda, ast.ClassDef)):
+            return  # separate scopes, analyzed on their own
+        if isinstance(node, ast.Assign):
+            self._sinks(node.value)
+            self._bind(node.targets, node.value)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if node.value is not None:
+                self._sinks(node.value)
+                self._bind([node.target], node.value)
+        elif isinstance(node, ast.For):
+            self._sinks(node.iter)
+            self._bind_loop_target(node)
+            for stmt in node.body + node.orelse:
+                self._stmt(stmt)
+        elif isinstance(node, ast.If):
+            # exclusive branches: a binding in one arm must not leak into
+            # the other; after the join, only names device in BOTH arms
+            # stay device (must-analysis — never guess)
+            self._sinks(node.test)
+            before = dict(self.device_vars)
+            for stmt in node.body:
+                self._stmt(stmt)
+            after_body = self.device_vars
+            self.device_vars = before if not node.orelse else dict(before)
+            for stmt in node.orelse:
+                self._stmt(stmt)
+            after_else = self.device_vars
+            self.device_vars = {
+                k: v for k, v in after_body.items() if k in after_else
+            }
+        elif isinstance(node, ast.Try):
+            before = dict(self.device_vars)
+            for stmt in node.body + node.orelse:
+                self._stmt(stmt)
+            after_body = self.device_vars
+            for handler in node.handlers:
+                self.device_vars = dict(before)
+                for stmt in handler.body:
+                    self._stmt(stmt)
+                after_body = {
+                    k: v for k, v in after_body.items()
+                    if k in self.device_vars
+                }
+            self.device_vars = after_body
+            for stmt in node.finalbody:
+                self._stmt(stmt)
+        elif isinstance(node, ast.expr):
+            self._sinks(node)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._sinks(child)
+                else:
+                    self._stmt(child)
+
+    def _bind(self, targets: list[ast.AST], value: ast.AST) -> None:
+        chain = self.provenance(value)
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    if chain:
+                        self.device_vars[sub.id] = chain
+                    else:
+                        self.device_vars.pop(sub.id, None)
+
+    def _bind_loop_target(self, node: ast.For) -> None:
+        if not isinstance(node.iter, ast.Call):
+            return
+        resolved = resolve_dotted(_dotted(node.iter.func), self.aliases)
+        hit = self.index.lookup_from(self.module, resolved)
+        if hit is None or not hit[1].yields_device:
+            return
+        name, summary = hit
+        chain = f"yielded by {name}() [{summary.device_reason}]"
+        for sub in ast.walk(node.target):
+            if isinstance(sub, ast.Name):
+                self.device_vars[sub.id] = chain
+
+    # -- sink detection --------------------------------------------------
+
+    def _sinks(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            # .tolist() on a device value
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "tolist" and not node.args:
+                chain = self.provenance(node.func.value)
+                if chain:
+                    self._report(
+                        node,
+                        ".tolist() forces a blocking device→host "
+                        f"transfer: the receiver is device-resident "
+                        f"({self._describe(node.func.value)} ← {chain})",
+                    )
+                continue
+            resolved = resolve_dotted(_dotted(node.func), self.aliases)
+            if not resolved or not node.args:
+                continue
+            base, _, attr = resolved.rpartition(".")
+            sink = None
+            if base == "numpy" and attr in _GL013_NP_SINKS:
+                sink = f"np.{attr}"
+            elif resolved in _GL013_JNP_SINKS:
+                sink = f"jnp.{attr}"
+            if sink is None:
+                continue
+            chain = self.provenance(node.args[0])
+            if not chain:
+                continue
+            if sink.startswith("np."):
+                msg = (
+                    f"{sink}(...) on a device-resident value forces an "
+                    "implicit device→host transfer"
+                )
+                fix = (
+                    "read it back explicitly with jax.device_get (one "
+                    "visible sync) or keep the math in jnp"
+                )
+            else:
+                msg = (
+                    f"{sink}(...) re-wraps a value that is already on "
+                    "device — at best a no-op, at worst a hidden copy/cast"
+                )
+                fix = "drop the conversion (or make the cast explicit)"
+            self._report(
+                node,
+                f"{msg}: {self._describe(node.args[0])} ← {chain}; {fix}",
+            )
+
+    def _describe(self, expr: ast.AST) -> str:
+        try:
+            src = ast.unparse(expr)
+        except Exception:  # pragma: no cover - defensive
+            src = "<expr>"
+        return src if len(src) <= 40 else src[:37] + "…"
+
+    def _report(self, node: ast.AST, message: str) -> None:
+        key = (node.lineno, node.col_offset)
+        if key not in self._reported:
+            self._reported.add(key)
+            self.findings.append(
+                self.ctx.finding(self.rule, node, message)
+            )
+
+
+@register
+class ImplicitTransferRule(ProjectRule):
+    id = "GL013"
+    name = "implicit-host-transfer"
+    severity = "warning"
+    rationale = (
+        "np.asarray/.tolist()/np.* math on a value whose provenance traces "
+        "to device arrays (a traced-fn result, a prefetched batch) is a "
+        "hidden blocking device→host transfer — even two calls deep in "
+        "another module; the sanitizer gate (scripts/sanitize.sh) enforces "
+        "the same claim at runtime via jax.transfer_guard"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # package code only (benches/tests/scripts read back on purpose),
+        # minus the linter itself
+        return _in_package(ctx) and not ctx.relpath.startswith(
+            _GL013_EXCLUDED
+        )
+
+    def check_project(self, ctx: FileContext,
+                      index: ProjectIndex) -> list[Finding]:
+        aliases = index.aliases_for(ctx.relpath, ctx.tree)
+        traced = traced_node_ids(ctx)
+        out: list[Finding] = []
+        # module scope + every non-traced function, each a fresh dataflow
+        # (traced scopes belong to GL001: inside a trace these calls are a
+        # trace error, not a quiet transfer)
+        scopes: list[list[ast.stmt]] = [ctx.tree.body]
+        for node in ctx.walk_nodes():
+            if isinstance(node, _FUNC_NODES) and id(node) not in traced:
+                scopes.append(node.body)
+        for body in scopes:
+            out.extend(_DeviceFlow(self, ctx, index, aliases).run(body))
+        return out
+
+
+# ---- GL014: cross-function PRNG key reuse -----------------------------------
+
+@register
+class CrossFunctionKeyReuseRule(ProjectRule):
+    id = "GL014"
+    name = "cross-function-prng-key-reuse"
+    severity = "error"
+    rationale = (
+        "a key handed to a callee that CONSUMES it (directly or further "
+        "down the call graph) and then reused by the caller draws the same "
+        "randomness twice — GL002 past function boundaries, resolved "
+        "through the project call graph"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # tests reuse keys deliberately (determinism assertions)
+        return not _is_test_file(ctx)
+
+    def check_project(self, ctx: FileContext,
+                      index: ProjectIndex) -> list[Finding]:
+        aliases = index.aliases_for(ctx.relpath, ctx.tree)
+        module = index.module_of(ctx.relpath)
+        out: list[Finding] = []
+        for node in ctx.walk_nodes():
+            if isinstance(node, _FUNC_NODES):
+                out.extend(
+                    self._check_function(ctx, index, aliases, module, node)
+                )
+        return out
+
+    def _check_function(self, ctx: FileContext, index: ProjectIndex,
+                        aliases: dict[str, str], module: str,
+                        fn: ast.AST) -> list[Finding]:
+        # events in source order, nested scopes excluded (same walk shape
+        # as GL002; the new event kind is "a callee spent this key")
+        events: list[tuple[int, int, str, str, ast.AST, str]] = []
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES + (ast.Lambda,)):
+                    continue
+                if isinstance(child, ast.Call):
+                    self._call_events(child, index, aliases, module, events)
+                if isinstance(child, (ast.Assign, ast.AugAssign,
+                                      ast.AnnAssign, ast.For, ast.withitem,
+                                      ast.NamedExpr)):
+                    for name in _bound_names(child):
+                        events.append((
+                            getattr(child, "lineno", 0),
+                            getattr(child, "col_offset", 0),
+                            "bind", name, child, "",
+                        ))
+                visit(child)
+
+        visit(fn)
+        events.sort(key=lambda e: (e[0], e[1]))
+
+        live: dict[str, tuple[ast.AST, str]] = {}
+        out: list[Finding] = []
+        for _, _, kind, payload, node, info in events:
+            if kind == "bind":
+                for expr in [e for e in live
+                             if re.search(rf"\b{re.escape(payload)}\b", e)]:
+                    del live[expr]
+                continue
+            if payload in live:
+                first_node, first_info = live[payload]
+                # pure-local double consumption is GL002's finding; this
+                # rule owns the pairs a single-file engine cannot see
+                if kind == "consume-callee" or first_info:
+                    where = (
+                        f"consumed by {first_info}" if first_info
+                        else "consumed by a jax.random call"
+                    )
+                    use = (
+                        f"passing it to {info}" if info
+                        else "this jax.random call"
+                    )
+                    out.append(ctx.finding(
+                        self, node,
+                        f"PRNG key {payload!r} was already {where} on line "
+                        f"{first_node.lineno}; {use} reuses it — split or "
+                        "fold_in first (identical keys give identical "
+                        "draws)",
+                    ))
+            else:
+                live[payload] = (node, info)
+        return out
+
+    @staticmethod
+    def _call_events(call: ast.Call, index: ProjectIndex,
+                     aliases: dict[str, str], module: str,
+                     events: list) -> None:
+        resolved = resolve_dotted(_dotted(call.func), aliases)
+        if not resolved:
+            return
+        base, _, attr = resolved.rpartition(".")
+        if base == "jax.random" and attr in _KEY_CONSUMERS:
+            key_arg = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg == "key":
+                    key_arg = kw.value
+            src = _unparse(key_arg)
+            if src:
+                events.append((call.lineno, call.col_offset,
+                               "consume-local", src, call, ""))
+            return
+        if resolved.startswith(("jax.", "numpy.")):
+            return
+        hit = index.lookup_from(module, resolved)
+        if hit is None or not hit[1].key_params_consumed:
+            return
+        name, summary = hit
+        for param in summary.key_params_consumed:
+            arg = None
+            try:
+                pos = summary.params.index(param)
+            except ValueError:
+                pos = -1
+            if 0 <= pos < len(call.args):
+                arg = call.args[pos]
+            for kw in call.keywords:
+                if kw.arg == param:
+                    arg = kw.value
+            src = _unparse(arg)
+            if src:
+                via = summary.key_consumed_via.get(param, "")
+                info = f"{name}() (parameter {param!r}"
+                info += f", spent via {via})" if via else ")"
+                events.append((call.lineno, call.col_offset,
+                               "consume-callee", src, call, info))
+
+
+def _unparse(node: ast.AST | None) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        return ""
+
+
+# ---- GL015: sharding-spec drift vs the mesh declaration ---------------------
+
+_GL015_SPEC_TYPES = {
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.pjit.PartitionSpec",
+    "jax.interpreters.pxla.PartitionSpec",
+}
+
+
+@register
+class ShardingSpecDriftRule(ProjectRule):
+    id = "GL015"
+    name = "sharding-spec-drift"
+    severity = "error"
+    rationale = (
+        "a PartitionSpec/NamedSharding axis literal that is not a mesh "
+        "axis train/mesh.py declares shards over an axis that does not "
+        "exist — an unbound-axis error at jit time, or (after a mesh "
+        "rename) a silently replicated array that was meant to be sharded; "
+        "every spec literal in the package resolves against the shared "
+        "project index's mesh declaration"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # package code only: tests spell fake axes on purpose
+        return _in_package(ctx) and not ctx.relpath.startswith(
+            "cst_captioning_tpu/tools/"
+        )
+
+    def check_project(self, ctx: FileContext,
+                      index: ProjectIndex) -> list[Finding]:
+        aliases = index.aliases_for(ctx.relpath, ctx.tree)
+        allowed = index.mesh.axes
+        out: list[Finding] = []
+        for node in ctx.walk_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = resolve_dotted(_dotted(node.func), aliases)
+            if resolved not in _GL015_SPEC_TYPES:
+                continue
+            for axis, anchor in self._axis_literals(node):
+                if axis not in allowed:
+                    out.append(ctx.finding(
+                        self, anchor,
+                        f"PartitionSpec axis {axis!r} is not a mesh axis "
+                        "train/mesh.py declares "
+                        f"({', '.join(sorted(allowed))}): the spec drifted "
+                        "from the mesh declaration — rename the axis or "
+                        "declare it in make_mesh",
+                    ))
+        return out
+
+    @staticmethod
+    def _axis_literals(call: ast.Call) -> list[tuple[str, ast.AST]]:
+        out: list[tuple[str, ast.AST]] = []
+        for arg in call.args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append((arg.value, arg))
+            elif isinstance(arg, (ast.Tuple, ast.List)):
+                for elt in arg.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        out.append((elt.value, elt))
+        return out

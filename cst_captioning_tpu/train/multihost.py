@@ -192,7 +192,10 @@ def put_full_global(shardings, full_tree):
         if hasattr(x, "dtype") and jax.dtypes.issubdtype(
             x.dtype, jax.dtypes.prng_key
         ):
-            data = np.asarray(jax.random.key_data(x))
+            # explicit readback (not np.asarray): this is a deliberate,
+            # once-per-restore host staging hop, and GL013 holds the hot
+            # paths to zero implicit device→host conversions
+            data = jax.device_get(jax.random.key_data(x))
             g = jax.make_array_from_process_local_data(
                 s, data, global_shape=data.shape
             )
@@ -232,9 +235,13 @@ def to_host_local(arr, mesh: Mesh, spec: P) -> np.ndarray:
 
 def from_host_local(arr, mesh: Mesh, spec: P):
     """THIS process's rows -> sharded global array (advantage upload).
-    Single-process: the identity."""
+
+    Single-process: an explicit sharded ``device_put`` — handing the jitted
+    update a single-device array instead would make XLA re-scatter it
+    device-to-device at EVERY dispatch (an implicit per-batch transfer the
+    sanitizer gate vetoes)."""
     if not is_multiprocess():
-        return arr
+        return jax.device_put(arr, jax.sharding.NamedSharding(mesh, spec))
     from jax.experimental import multihost_utils
 
     return multihost_utils.host_local_array_to_global_array(

@@ -68,7 +68,12 @@ from cst_captioning_tpu.rl import RewardComputer, SCSTTrainer
 from cst_captioning_tpu.train import multihost
 from cst_captioning_tpu.train.mesh import batch_sharding, make_mesh, replicate
 from cst_captioning_tpu.train.schedule import make_optimizer
-from cst_captioning_tpu.train.state import TrainState, create_train_state
+from cst_captioning_tpu.train.state import (
+    TrainState,
+    create_train_state,
+    device_fold_in,
+    device_key,
+)
 from cst_captioning_tpu.train.steps import batch_arrays, make_parallel_xe_step, make_xe_step
 from cst_captioning_tpu.utils.logging import EventLogger
 from cst_captioning_tpu.utils.profiling import StepProfiler
@@ -829,7 +834,7 @@ class Trainer:
         batch_no = skip
         # host-side step counter: reading int(self.state.step) per step in
         # the loop would block on the just-dispatched update every step
-        step_no = int(self.state.step)  # graftlint: disable=GL001 (once per epoch)
+        step_no = int(self.state.step)
         if obs.enabled():
             obs.set_context(phase="xe", epoch=self.epoch + 1)
         meter.begin_epoch()
@@ -904,7 +909,7 @@ class Trainer:
             sentinel.flush()
         self.epoch += 1
         self.xe_epochs += 1
-        vals = np.asarray(jax.device_get(losses), np.float64)  # graftlint: disable=GL001 (once per epoch)
+        vals = np.asarray(jax.device_get(losses), np.float64)
         vals = vals[np.isfinite(vals)]  # guard-skipped steps carry NaN losses
         self.log.log(
             "xe_epoch",
@@ -941,10 +946,15 @@ class Trainer:
         tx = make_optimizer(cfg.train, self.steps_per_epoch, lr_override=cfg.rl.lr)
         if self.rl_epochs == 0:
             # XE -> RL transition: fresh optimizer at RL LR (handoff semantics)
+            # device_put, not jnp.zeros: the reset step counter must reach
+            # the device via an EXPLICIT transfer, and tx.init runs jitted
+            # so its zero-moments materialize on device without staging
+            # eager scalar constants (sanitizer gate holds the RL hot loop
+            # under jax.transfer_guard("disallow"))
             self.state = self.state.replace(
-                step=jax.numpy.zeros((), jax.numpy.int32), opt_state=tx.init(
-                    jax.device_get(self.state.params)
-                ), tx=tx,
+                step=jax.device_put(np.zeros((), np.int32)),
+                opt_state=jax.jit(tx.init)(self.state.params),
+                tx=tx,
             )
             if self.mesh is not None:
                 self.state = replicate(self.mesh, self.state)
@@ -1065,15 +1075,17 @@ class Trainer:
         # (epoch k uses fold_in(base, k) whether or not the process
         # restarted); a rollback salt re-randomizes it together with the
         # batch order
-        base_rng = jax.random.key(cfg.train.seed + 1)
+        # device_key: eager jax.random.key would stage the seed through
+        # an implicit transfer once per epoch, inside the sanitized loop
+        base_rng = device_key(cfg.train.seed + 1)
         if self.batcher.salt:
-            base_rng = jax.random.fold_in(base_rng, self.batcher.salt)
-        ep_rng = jax.random.fold_in(base_rng, self.epoch)
+            base_rng = device_fold_in(base_rng, self.batcher.salt)
+        ep_rng = device_fold_in(base_rng, self.epoch)
         # mid-epoch resume: advance the per-batch split chain past the
         # ``skip`` batches the checkpoint already trained on
         for _ in range(skip):
             ep_rng = jax.random.split(ep_rng)[0]
-        step_counter = {"step": int(self.state.step)}  # graftlint: disable=GL001 (once per epoch)
+        step_counter = {"step": int(self.state.step)}
         batch_counter = {"n": skip}
         if obs.enabled():
             obs.set_context(phase="rl", epoch=self.epoch + 1)

@@ -1,19 +1,26 @@
 #!/usr/bin/env bash
-# Pre-commit gate: graftlint + a full bytecode compile.
+# Pre-commit gate: graftlint + a full bytecode compile + runtime smokes.
 #
 #   scripts/lint.sh
 #
 # Exits nonzero on (a) any NEW graftlint finding — baselined findings pass,
-# see graftlint.baseline — or (b) any file that doesn't byte-compile.
-# tier-1 runs the same graftlint check via tests/test_graftlint.py
-# (test_repo_is_graftlint_clean), so CI cannot drift from this script.
+# see graftlint.baseline — or a stale baseline entry / unused inline
+# suppression (--check-stale), or the two-pass lint exceeding its 2 s
+# budget; (b) any file that doesn't byte-compile; (c) the obs_report /
+# decode / sanitizer smokes failing. tier-1 runs the same graftlint check
+# via tests/test_graftlint.py (test_repo_is_graftlint_clean), so CI cannot
+# drift from this script.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# AST pass only — no JAX backend, no device, sub-second
+# Two-pass AST analysis only — no JAX backend, no device. Pass 1 builds the
+# whole-program project index (mtime-keyed summary cache keeps repeat runs
+# warm), pass 2 runs the per-file + interprocedural rules. --timings prints
+# the per-pass line; --budget asserts index+rules stay under 2 s.
 python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
-    bench.py bench_attention.py bench_decode.py bench_recipe.py
+    bench.py bench_attention.py bench_decode.py bench_recipe.py \
+    --check-stale --timings --budget 2
 
 # catches syntax errors in files graftlint may not reach (non-.py-suffixed
 # entry points aside, this is the whole tree)
@@ -30,5 +37,10 @@ python -m cst_captioning_tpu.cli.obs_report tests/fixtures/obs_run > /dev/null
 # bit-exactness gate inside — keeps bench_decode.py and the kernel from
 # rotting without a TPU in CI (README "Decode fast path")
 JAX_PLATFORMS=cpu python bench_decode.py --smoke > /dev/null
+
+# runtime sanitizer smoke: the hot-path tier-1 subset under
+# jax.transfer_guard("disallow") + jax.debug_nans — the empirical half of
+# GL001/GL013's zero-implicit-transfer claim (README "Static analysis")
+JAX_PLATFORMS=cpu scripts/sanitize.sh > /dev/null
 
 echo "lint.sh: OK"

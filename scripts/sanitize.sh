@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Runtime sanitizer gate: empirically enforce graftlint's GL001/GL013
+# "zero implicit host<->device transfers on the hot path" claim.
+#
+#   scripts/sanitize.sh [extra pytest args...]
+#
+# Runs the sanitize subset of tier-1 under `pytest --sanitize`
+# (jax.transfer_guard("disallow") + jax.debug_nans — see tests/conftest.py):
+#
+#   - tests/test_sanitize.py: full XE + RL epochs through the real Trainer
+#     with the guard clamped around the epoch hot loops (setup runs
+#     unguarded, as in production). Any batch reaching a jitted step
+#     without an explicit device_put, any eager scalar staged inside the
+#     loop, and any NaN update fails the run.
+#   - tests/test_data.py: the prefetch H2D staging path under a blanket
+#     per-test guard (every transfer in the input pipeline must be an
+#     explicit device_put).
+#
+# CPU-only and fast (~15 s): lint.sh invokes this as a smoke; run it on
+# TPU by clearing JAX_PLATFORMS.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+    tests/test_sanitize.py tests/test_data.py \
+    -q -m 'not slow' --sanitize -p no:cacheprovider "$@"
+
+echo "sanitize.sh: OK — hot path ran clean under jax.transfer_guard(disallow)"

@@ -44,3 +44,35 @@ def pytest_configure(config):
         "markers", "slow: needs real TPU hardware or long wall-clock; "
         "excluded from tier-1 (-m 'not slow')"
     )
+    config.addinivalue_line(
+        "markers", "no_sanitize: opted out of the --sanitize transfer "
+        "guard (the test's PURPOSE is an implicit transfer or a NaN path)"
+    )
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--sanitize", action="store_true", default=False,
+        help="run every test under jax.transfer_guard('disallow') + "
+        "jax.debug_nans: the runtime cross-check of graftlint's "
+        "GL001/GL013 zero-implicit-transfer claim (scripts/sanitize.sh "
+        "drives this over the hot-path tier-1 subset)",
+    )
+
+
+import pytest  # noqa: E402  (after the backend-forcing block above)
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_gate(request):
+    """With --sanitize, fail any test that performs an implicit host<->
+    device transfer (explicit device_put/device_get stay allowed — the
+    whole point is that every transfer must be a visible decision) or
+    produces a NaN. graftlint proves the claim lexically; this proves it
+    at runtime."""
+    if not request.config.getoption("--sanitize") or \
+            request.node.get_closest_marker("no_sanitize"):
+        yield
+        return
+    with jax.transfer_guard("disallow"), jax.debug_nans(True):
+        yield

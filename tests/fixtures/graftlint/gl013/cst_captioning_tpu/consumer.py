@@ -1,0 +1,30 @@
+"""GL013 cross-file fixture — the HOST side of the pair.
+
+Every conversion below operates on a value whose device provenance is
+declared in ``producer.py`` (a different module): linting this file ALONE
+must find nothing, linting the pair must flag ``to_host`` and ``loop``.
+"""
+
+import numpy as np
+
+from cst_captioning_tpu.producer import decode, prefetched
+
+
+def to_host(feats):
+    tokens = decode(feats)
+    return np.asarray(tokens)  # GL013: device provenance lives in producer.py
+
+
+def to_host_suppressed(feats):
+    tokens = decode(feats)
+    return np.asarray(tokens)  # graftlint: disable=GL013 (fixture: intentional readback)
+
+
+def loop(batches, out):
+    for batch in prefetched(batches):
+        out.append(batch.tolist())  # GL013: prefetched batches are device-resident
+
+
+def host_only(rows):
+    # no device provenance anywhere: must stay quiet
+    return np.asarray([len(r) for r in rows])

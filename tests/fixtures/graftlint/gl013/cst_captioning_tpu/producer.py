@@ -1,0 +1,29 @@
+"""GL013 cross-file fixture — the DEVICE side of the pair.
+
+``decode`` returns a device value two hops deep (through the jitted
+``encode``); ``prefetched`` is the device-yielding generator pattern
+(stages via ``jax.device_put``, yields through a queue-shaped hop). A
+per-file engine reading only ``consumer.py`` cannot know either fact —
+that is exactly what this pair proves (see tests/test_graftlint.py).
+
+Deliberately lint-dirty directory: skipped by the repo-wide walk
+(``fixtures`` is in core._SKIP_DIRS), linted explicitly by the tests.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def encode(x):
+    return jnp.tanh(x)
+
+
+def decode(feats):
+    # un-decorated, but its return provenance traces to the traced encode
+    return encode(feats) * 2
+
+
+def prefetched(batches):
+    for b in batches:
+        yield jax.device_put(b)

@@ -1,0 +1,42 @@
+"""GL014 cross-file fixture — callers reusing keys a callee already spent.
+
+``double_draw`` and ``transitive`` must be flagged when linted together
+with ``keys_lib.py``; alone, this file must lint clean (the consumption
+fact lives in the other module).
+"""
+
+import jax
+
+from cst_captioning_tpu.keys_lib import sample_rollout, splitter, wrapped
+
+
+def double_draw(key):
+    a = sample_rollout(key, (2,))
+    b = jax.random.uniform(key, (2,))  # GL014: key spent by sample_rollout
+    return a + b
+
+
+def transitive(key):
+    a = wrapped(key, (2,))
+    b = wrapped(key, (2,))  # GL014: both consumptions happen via callees
+    return a + b
+
+
+def fresh(key):
+    k1, k2 = jax.random.split(key)
+    a = sample_rollout(k1, (2,))
+    b = jax.random.uniform(k2, (2,))
+    return a + b
+
+
+def split_then_use(key):
+    # splitter does not consume: reuse after it is fine
+    k1, k2 = splitter(key)
+    a = sample_rollout(k1, (2,))
+    return a, k2
+
+
+def suppressed(key):
+    a = sample_rollout(key, (2,))
+    b = jax.random.uniform(key, (2,))  # graftlint: disable=GL014 (fixture: deliberate correlated draw)
+    return a + b

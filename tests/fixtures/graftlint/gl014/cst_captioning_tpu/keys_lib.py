@@ -1,0 +1,22 @@
+"""GL014 cross-file fixture — the CONSUMING callees.
+
+``sample_rollout`` spends its ``key`` parameter directly;
+``wrapped`` spends it one call deeper (the summary fixpoint sees through
+the hop). Callers in ``caller.py`` must not reuse a key after passing it
+here — a fact no per-file engine can know from the caller alone.
+"""
+
+import jax
+
+
+def sample_rollout(key, shape):
+    return jax.random.normal(key, shape)
+
+
+def wrapped(key, shape):
+    return sample_rollout(key, shape)
+
+
+def splitter(key):
+    # does NOT consume: callers may keep using their key afterwards
+    return jax.random.split(key)

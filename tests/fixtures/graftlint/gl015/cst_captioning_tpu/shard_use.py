@@ -1,0 +1,25 @@
+"""GL015 cross-file fixture — PartitionSpec literals that must resolve
+against the axes ``train/mesh.py`` (a different module) declares.
+
+``drifted`` spells 'data', which THIS fixture's mesh does not declare —
+a per-file engine has no way to know that.
+"""
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def good(mesh):
+    return NamedSharding(mesh, P("model", "pipeline"))
+
+
+def drifted(mesh):
+    return NamedSharding(mesh, P("data"))  # GL015: not an axis of THIS mesh
+
+
+def suppressed(mesh):
+    return NamedSharding(mesh, P("data"))  # graftlint: disable=GL015 (fixture)
+
+
+def dynamic(mesh, axis):
+    # dynamic axis expressions are out of scope
+    return NamedSharding(mesh, P(axis))

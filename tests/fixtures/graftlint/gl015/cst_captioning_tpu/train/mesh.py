@@ -1,0 +1,10 @@
+"""GL015 cross-file fixture — the mesh DECLARATION side of the pair.
+
+Declares axes 'model' and 'pipeline' (the string defaults of *axis
+parameters, same scrape as the real train/mesh.py). ``shard_use.py``'s
+spec literals must resolve against THESE axes, not a hardcoded list.
+"""
+
+
+def make_mesh(num_devices=0, axis="model", seq_axis="pipeline"):
+    return None

@@ -28,12 +28,26 @@ REPO_LINT_PATHS = [
 ]
 
 
+# deliberately lint-dirty cross-file fixture pairs (skipped by the repo
+# walk — "fixtures" is in core._SKIP_DIRS — and linted explicitly here)
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftlint")
+
+
 def _lint(tmp_path, relname: str, source: str, rules=None):
     path = tmp_path / relname
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(source)
-    result = lint_paths([str(path)], str(tmp_path), rule_ids=rules)
+    # cache_path="": unit fixtures rewrite files faster than mtime
+    # granularity; the cache has its own dedicated tests
+    result = lint_paths([str(path)], str(tmp_path), rule_ids=rules,
+                        cache_path="")
     return result.findings
+
+
+def _lint_fixture(sub: str, rules, only: str | None = None):
+    root = os.path.join(FIXTURES, sub)
+    paths = [os.path.join(root, only)] if only else [root]
+    return lint_paths(paths, root, rule_ids=rules, cache_path="").findings
 
 
 def _rules_of(findings):
@@ -653,6 +667,12 @@ def test_cli_json_schema(tmp_path, capsys):
         "baselined",
     }
     assert finding["rule"] == "GL001" and finding["line"] == 4
+    # the two-pass engine's bookkeeping rides along in the report
+    assert report["stale_baseline"] == []
+    assert report["unused_suppressions"] == []
+    timings = report["timings"]
+    assert {"index_seconds", "rules_seconds"} <= set(timings)
+    assert timings["files"] == 1
 
 
 def test_cli_write_baseline_then_clean(tmp_path, capsys):
@@ -673,7 +693,8 @@ def test_cli_list_rules_names_all_registered(tmp_path, capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                "GL007", "GL008", "GL009", "GL010", "GL011", "GL012"):
+                "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
+                "GL013", "GL014", "GL015"):
         assert rid in out
 
 
@@ -782,6 +803,144 @@ def test_gl011_negative_nested_def_returns_ignored(tmp_path):
         "    return jax.lax.scan(body, init, xs)\n"
     ), rules=["GL011"])
     assert findings == []
+
+
+# ---- project index: summary cache + provenance fixpoint ---------------------
+
+def test_summary_cache_invalidation(tmp_path):
+    """Edit a file (mtime/size change) -> its summary is recomputed; an
+    untouched file is served from the on-disk cache."""
+    import time as _time
+
+    from cst_captioning_tpu.tools.graftlint import ProjectIndex
+
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import numpy as np\n"
+        "def f():\n"
+        "    return np.zeros(3)\n"
+    )
+    cache = tmp_path / "cache.json"
+    idx = ProjectIndex.build([str(mod)], str(tmp_path),
+                             cache_path=str(cache))
+    assert idx.stats.summarized >= 1 and cache.exists()
+    assert not idx.functions["m.f"].returns_device
+
+    idx2 = ProjectIndex.build([str(mod)], str(tmp_path),
+                              cache_path=str(cache))
+    assert idx2.stats.summarized == 0 and idx2.stats.cached >= 1
+    assert not idx2.functions["m.f"].returns_device
+
+    mod.write_text(
+        "import jax.numpy as jnp\n"
+        "def f():\n"
+        "    return jnp.zeros(3)\n"
+    )
+    future = _time.time() + 10
+    os.utime(mod, (future, future))
+    idx3 = ProjectIndex.build([str(mod)], str(tmp_path),
+                              cache_path=str(cache))
+    assert idx3.stats.summarized >= 1
+    assert idx3.functions["m.f"].returns_device
+
+
+def test_index_fixpoint_transitive_device_returns(tmp_path):
+    """returns-device provenance propagates through the call graph across
+    modules (a -> b -> jnp)."""
+    from cst_captioning_tpu.tools.graftlint import ProjectIndex
+
+    (tmp_path / "a.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def leaf(x):\n"
+        "    return jnp.tanh(x)\n"
+    )
+    (tmp_path / "b.py").write_text(
+        "from a import leaf\n"
+        "def mid(x):\n"
+        "    return leaf(x)\n"
+        "def top(x):\n"
+        "    return mid(x)\n"
+    )
+    idx = ProjectIndex.build(
+        [str(tmp_path / "a.py"), str(tmp_path / "b.py")],
+        str(tmp_path), cache_path="",
+    )
+    assert idx.functions["a.leaf"].returns_device
+    assert idx.functions["b.mid"].returns_device
+    assert idx.functions["b.top"].returns_device
+
+
+# ---- --check-stale: dead baseline entries + dead suppressions ---------------
+
+def test_stale_baseline_entries_reported(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return float(x)\n"
+    )
+    live = lint_paths([str(path)], str(tmp_path), cache_path="")
+    bl = Baseline.from_findings(live.findings)
+    bl.entries.append({
+        "rule": "GL001", "path": "mod.py",
+        "context": "return np.asarray(ghost)", "count": 1,
+        "reason": "the code site was fixed long ago",
+    })
+    result = lint_paths([str(path)], str(tmp_path), baseline=bl,
+                        cache_path="")
+    assert result.gating == []  # the live finding is still covered
+    assert [e["context"] for e in result.stale_baseline] == [
+        "return np.asarray(ghost)"
+    ]
+
+
+def test_unused_suppressions_reported(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return np.asarray(x)  # graftlint: disable=GL001 (used)\n"
+        "def host(x):\n"
+        "    return x  # graftlint: disable=GL003 (nothing ever fires here)\n"
+    )
+    result = lint_paths([str(path)], str(tmp_path), cache_path="")
+    assert [(s["line"], s["rule"]) for s in result.unused_suppressions] == [
+        (7, "GL003")
+    ]
+
+
+def test_cli_check_stale_gates(tmp_path, capsys):
+    path = tmp_path / "mod.py"
+    path.write_text(
+        "def f(x):\n"
+        "    return x  # graftlint: disable=GL001 (dead)\n"
+    )
+    (tmp_path / "graftlint.baseline").write_text(json.dumps(
+        {"version": 1, "entries": []}
+    ))
+    assert cli_main([str(path), "--root", str(tmp_path)]) == 0
+    capsys.readouterr()
+    rc = cli_main([str(path), "--root", str(tmp_path), "--check-stale"])
+    err = capsys.readouterr().err
+    assert rc == 1 and "unused suppression" in err
+    # --check-stale without the full rule set is a usage error
+    assert cli_main([str(path), "--root", str(tmp_path), "--check-stale",
+                     "--rules", "GL001"]) == 2
+
+
+def test_cli_timings_and_budget(tmp_path, capsys):
+    path = tmp_path / "mod.py"
+    path.write_text("def f():\n    return 1\n")
+    assert cli_main([str(path), "--root", str(tmp_path), "--timings"]) == 0
+    err = capsys.readouterr().err
+    assert "index" in err and "rules" in err
+    # an absurdly small budget must fail the run
+    assert cli_main([str(path), "--root", str(tmp_path),
+                     "--budget", "0.000001"]) == 1
+    assert "budget" in capsys.readouterr().err
 
 
 # ---- tier-1 self-check: the repo itself stays lint-clean --------------------
@@ -901,4 +1060,189 @@ def test_gl012_negative_tests_out_of_scope(tmp_path):
         "def f(x):\n"
         "    return jax.lax.psum(x, 'i')\n"
     ), rules=["GL012"])
+    assert findings == []
+
+
+def test_gl012_mesh_axes_rescrape_within_one_process(tmp_path):
+    """The stale-cache fix: editing train/mesh.py between two lint runs in
+    the SAME process must change the allowed axis set (the scrape lives on
+    the per-run project index now, not a module-level cache)."""
+    import time as _time
+
+    mesh = tmp_path / "cst_captioning_tpu" / "train" / "mesh.py"
+    mesh.parent.mkdir(parents=True, exist_ok=True)
+    mesh.write_text("def make_mesh(num_devices=0, axis='alpha'):\n    pass\n")
+    src = (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.lax.psum(x, 'alpha')\n"
+    )
+    assert _lint(tmp_path, "cst_captioning_tpu/mod.py", src,
+                 rules=["GL012"]) == []
+    mesh.write_text("def make_mesh(num_devices=0, axis='beta'):\n    pass\n")
+    future = _time.time() + 10
+    os.utime(mesh, (future, future))
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", src,
+                     rules=["GL012"])
+    assert _rules_of(findings) == ["GL012"] and "'alpha'" in findings[0].message
+
+
+# ---- GL013: implicit host transfers (interprocedural) -----------------------
+
+def test_gl013_cross_file_device_provenance():
+    """The acceptance pair: np.asarray / .tolist() on values whose device
+    provenance is declared in ANOTHER module (traced-fn result, device-
+    yielding prefetch generator); the suppressed twin stays quiet."""
+    findings = _lint_fixture("gl013", ["GL013"])
+    assert len(findings) == 2
+    assert all(f.rule == "GL013" and f.path.endswith("consumer.py")
+               for f in findings)
+    by_ctx = {f.context: f for f in findings}
+    asarray = next(f for c, f in by_ctx.items() if "np.asarray(tokens)" in c)
+    tolist = next(f for c, f in by_ctx.items() if ".tolist()" in c)
+    # the finding message carries the interprocedural path
+    assert "cst_captioning_tpu.producer.decode" in asarray.message
+    assert "jit-traced" in asarray.message
+    assert "cst_captioning_tpu.producer.prefetched" in tolist.message
+
+
+def test_gl013_single_file_engine_provably_cannot():
+    """Linting the consumer ALONE must find nothing: the provenance facts
+    live in producer.py, out of any per-file engine's reach."""
+    assert _lint_fixture(
+        "gl013", ["GL013"], only="cst_captioning_tpu/consumer.py"
+    ) == []
+
+
+def test_gl013_branch_sensitive_no_false_positive(tmp_path):
+    """A host rebinding in one branch must not inherit the other branch's
+    device provenance (the real scst.py seam pattern)."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def seam(samples, mesh):\n"
+        "    if mesh is not None:\n"
+        "        samples = jax.device_put(samples)\n"
+        "    else:\n"
+        "        samples = np.asarray(samples)\n"
+        "    return np.asarray(samples)\n"
+    ), rules=["GL013"])
+    assert findings == []
+
+
+def test_gl013_local_device_provenance_and_explicit_readback(tmp_path):
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def bad(x):\n"
+        "    y = jnp.tanh(x)\n"
+        "    return np.asarray(y)\n"
+        "def good(x):\n"
+        "    y = jnp.tanh(x)\n"
+        "    return np.asarray(jax.device_get(y))\n"
+    ), rules=["GL013"])
+    assert len(findings) == 1 and findings[0].line == 6
+
+
+def test_gl013_not_applied_outside_package(tmp_path):
+    # benches/tests/scripts read back on purpose
+    findings = _lint(tmp_path, "tests/helper.py", (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(x):\n"
+        "    return np.asarray(jnp.tanh(x))\n"
+    ), rules=["GL013"])
+    assert findings == []
+
+
+# ---- GL014: cross-function PRNG key reuse -----------------------------------
+
+def test_gl014_cross_file_key_reuse():
+    """The acceptance pair: a key spent by a callee (directly, and through
+    one extra call hop) then reused by the caller; split/fold_in and the
+    suppressed twin stay quiet."""
+    findings = _lint_fixture("gl014", ["GL014"])
+    assert len(findings) == 2
+    assert all(f.rule == "GL014" and f.path.endswith("caller.py")
+               for f in findings)
+    direct, transitive = findings
+    assert "cst_captioning_tpu.keys_lib.sample_rollout" in direct.message
+    assert "jax.random.normal" in direct.message
+    assert "cst_captioning_tpu.keys_lib.wrapped" in transitive.message
+
+
+def test_gl014_single_file_engine_provably_cannot():
+    assert _lint_fixture(
+        "gl014", ["GL014"], only="cst_captioning_tpu/caller.py"
+    ) == []
+
+
+def test_gl014_local_reuse_stays_gl002(tmp_path):
+    """Pure same-function double consumption belongs to GL002 — GL014 only
+    owns pairs involving a callee, so the two never double-report."""
+    src = (
+        "import jax\n"
+        "def f(key):\n"
+        "    a = jax.random.normal(key, (2,))\n"
+        "    b = jax.random.uniform(key, (2,))\n"
+        "    return a + b\n"
+    )
+    assert _lint(tmp_path, "mod.py", src, rules=["GL014"]) == []
+    assert _rules_of(_lint(tmp_path, "mod.py", src, rules=["GL002"])) == [
+        "GL002"
+    ]
+
+
+def test_gl014_not_applied_in_tests(tmp_path):
+    findings = _lint(tmp_path, "tests/test_fake.py", (
+        "import jax\n"
+        "def consume(k):\n"
+        "    return jax.random.normal(k, (2,))\n"
+        "def test_reuse(key):\n"
+        "    a = consume(key)\n"
+        "    b = jax.random.uniform(key, (2,))\n"
+        "    assert (a != b).any()\n"
+    ), rules=["GL014"])
+    assert findings == []
+
+
+# ---- GL015: sharding-spec drift ---------------------------------------------
+
+def test_gl015_cross_file_axis_drift():
+    """The acceptance pair: a PartitionSpec literal checked against axes
+    declared in the OTHER module (train/mesh.py); declared axes, dynamic
+    specs, and the suppressed twin stay quiet."""
+    findings = _lint_fixture("gl015", ["GL015"])
+    assert len(findings) == 1
+    (f,) = findings
+    assert f.rule == "GL015" and f.path.endswith("shard_use.py")
+    assert "'data'" in f.message
+    # the allowed set names the axes that only mesh.py declares
+    assert "model" in f.message and "pipeline" in f.message
+
+
+def test_gl015_repo_axes_pass(tmp_path):
+    """With no fixture mesh the default data/seq axes apply — the repo's
+    own spec literals must lint clean under them."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "from jax.sharding import PartitionSpec as P\n"
+        "def f():\n"
+        "    return P('data', 'seq'), P(None), P(('data', 'seq'))\n"
+    ), rules=["GL015"])
+    assert findings == []
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "from jax.sharding import PartitionSpec as P\n"
+        "def f():\n"
+        "    return P('model')\n"
+    ), rules=["GL015"])
+    assert _rules_of(findings) == ["GL015"]
+
+
+def test_gl015_not_applied_in_tests(tmp_path):
+    findings = _lint(tmp_path, "tests/test_mod.py", (
+        "from jax.sharding import PartitionSpec as P\n"
+        "S = P('i')\n"
+    ), rules=["GL015"])
     assert findings == []
