@@ -41,6 +41,14 @@ Every (stride, compact) combination is token- and logprob-exact vs the
 stride-1 uncompacted loop under a fixed rng — selection noise is always
 drawn in ORIGINAL batch order and gathered through the compaction
 permutation, so a row's RNG stream follows it through the shuffle.
+
+The serving engine (cst_captioning_tpu/serving/engine.py) drives the SAME
+stride machinery as an always-on service: its admission loop re-packs the
+active prefix between strides exactly like the compaction here, but with
+per-REQUEST RNG streams and a paged encoder bank gathered per stride —
+``fused_decode_stride``'s ``mem_lens`` argument carries the per-row ragged
+lengths; the offline paths below pass none (uniform M), which compiles to
+the identical program.
 """
 
 from __future__ import annotations

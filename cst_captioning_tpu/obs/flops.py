@@ -140,3 +140,47 @@ def xe_flops_per_row(
         F, d_embed, d_hidden, d_att, V, feat_dims, num_layers
     )
     return float(3 * (enc + T * per_tok))
+
+
+# ---- XLA HLO cost-analysis backend ------------------------------------------
+#
+# The analytic counters above are matmul-only estimates; XLA's own HLO cost
+# analysis counts the COMPILED program (every fused op, the real
+# elementwise/softmax work, rematerialization). When a jitted callable and
+# its example arguments are at hand — benches, the serving engine — prefer
+# compiled-program FLOPs for the MFU ledger and fall back to the analytic
+# model when the backend can't report them (interpret-mode Pallas calls,
+# older runtimes, lowerings without cost data). jax imports stay INSIDE the
+# function: this module must keep importing on jax-free boxes
+# (cli.obs_report's contract).
+
+
+def compiled_cost(fn, *args, **kwargs) -> dict | None:
+    """``{"flops": float, "bytes_accessed": float}`` of ``jit(fn)(*args)``
+    per XLA's HLO cost analysis, or None when unavailable (no jax, no
+    backend cost model, analysis raises). ``fn`` may already be jitted
+    (anything with ``.lower``)."""
+    try:
+        import jax
+    except Exception:  # pragma: no cover - jax-free box
+        return None
+    try:
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        analysis = jitted.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else None
+        if not analysis:
+            return None
+        flops = float(analysis.get("flops", 0.0) or 0.0)
+        if flops <= 0.0:
+            return None
+        return {
+            "flops": flops,
+            "bytes_accessed": float(
+                analysis.get("bytes accessed", 0.0) or 0.0
+            ),
+        }
+    except Exception:
+        # cost analysis is best-effort by contract: any backend refusal
+        # degrades to the analytic model, never to a crash
+        return None

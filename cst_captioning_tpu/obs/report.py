@@ -53,10 +53,15 @@ _PROC_DIR_RE = re.compile(r"^proc(\d+)$")
 # canonical phase order for the table; unknown names sort after, by total
 _PHASE_ORDER = (
     "setup", "xe.epoch", "xe.step", "rl.epoch", "rl.decode", "rl.reward",
-    "rl.update", "eval", "eval.score", "ckpt", "ckpt.save", "ckpt.restore",
+    "rl.update", "eval", "eval.score", "serving.admit", "serving.encode",
+    "serving.stride", "serving.detok", "ckpt", "ckpt.save", "ckpt.restore",
     "dcn.collective", "degraded_rendezvous", "prefetch.stage",
     "profile.window",
 )
+
+# per-request serving phases surfaced as their own report section (the
+# engine records one histogram observation per request per phase)
+_SERVING_PHASES = ("queue_wait", "encode", "decode", "detok")
 
 
 def load_events(run_dir: str) -> list[dict]:
@@ -228,6 +233,42 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
             ),
         }
 
+    # serving section (serving/engine.py): request funnel counters + the
+    # per-request phase histograms (queue-wait / encode / decode / detok)
+    # and the paged-bank gauges. None when the run never served.
+    serving = None
+    lat = histograms.get("serving.latency_seconds")
+    if counters.get("serving.requests_submitted") or (
+        lat and lat.get("count")
+    ):
+        phases_out = {}
+        for name in _SERVING_PHASES:
+            h = histograms.get(f"serving.{name}_seconds")
+            if h and h.get("count"):
+                phases_out[name] = {
+                    "count": h["count"],
+                    "p50_s": _hist_quantile(h, 0.50),
+                    "p95_s": _hist_quantile(h, 0.95),
+                    "max_s": h.get("max", 0.0),
+                }
+        serving = {
+            "submitted": counters.get("serving.requests_submitted", 0),
+            "admitted": counters.get("serving.requests_admitted", 0),
+            "completed": counters.get("serving.requests_completed", 0),
+            "strides": counters.get("serving.strides", 0),
+            "drains": counters.get("serving.drains", 0),
+            "admission_blocked_pages": counters.get(
+                "serving.admission_blocked_pages", 0
+            ),
+            "latency_p50_s": _hist_quantile(lat, 0.50) if lat else 0.0,
+            "latency_p95_s": _hist_quantile(lat, 0.95) if lat else 0.0,
+            "latency_max_s": (lat or {}).get("max", 0.0),
+            "phases": phases_out,
+            "pages_in_use": gauges.get("serving.pages_in_use"),
+            "slots_in_use": gauges.get("serving.slots_in_use"),
+            "queue_depth": gauges.get("serving.queue_depth"),
+        }
+
     resilience = {
         "nan_skips": counters.get("resilience.nan_skip", 0),
         "divergences": sum(
@@ -284,6 +325,7 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
         "phases": phases,
         "overlap": overlap_rows,
         "decode": decode,
+        "serving": serving,
         "resilience": resilience,
         "health": health,
         "compile": {
@@ -368,6 +410,36 @@ def render_report(report: dict[str, Any]) -> str:
                 f"({100.0 * d['compaction_saved_frac']:.1f}% of lane-steps "
                 "compacted away)"
             )
+    sv = report.get("serving")
+    if sv:
+        lines.append("")
+        lines.append(
+            f"serving: {int(sv['submitted'])} submitted, "
+            f"{int(sv['admitted'])} admitted, {int(sv['completed'])} "
+            f"completed over {int(sv['strides'])} stride(s); latency "
+            f"p50/p95/max {sv['latency_p50_s']:.3f}/"
+            f"{sv['latency_p95_s']:.3f}/{sv['latency_max_s']:.3f}s"
+        )
+        for name in _SERVING_PHASES:
+            p = sv["phases"].get(name)
+            if p:
+                lines.append(
+                    f"  {name:<12} {int(p['count']):>6} req(s)  p50 "
+                    f"{p['p50_s']:.4f}s  p95 {p['p95_s']:.4f}s  max "
+                    f"{p['max_s']:.4f}s"
+                )
+        bits = []
+        if sv["drains"]:
+            bits.append(f"drains: {int(sv['drains'])}")
+        if sv["admission_blocked_pages"]:
+            bits.append(
+                "page backpressure: "
+                f"{int(sv['admission_blocked_pages'])} blocked admission(s)"
+            )
+        if sv.get("pages_in_use") is not None:
+            bits.append(f"pages in use: {int(sv['pages_in_use'])}")
+        if bits:
+            lines.append("  " + "   ".join(bits))
     r = report["resilience"]
     lines.append("")
     lines.append("resilience:")

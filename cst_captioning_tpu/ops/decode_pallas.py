@@ -107,10 +107,13 @@ def _lstm_math(x, c, h, wi, wh, b):
     return c_new, h_new
 
 
-def _reference(cell_params, carry, token, memory, memory_proj, memory_mask):
+def _reference(cell_params, carry, token, memory, memory_proj, memory_mask,
+               mem_lens=None):
     """The decode step as a plain-jnp composite over the cell's param tree
     (f32 compute, like the kernel) — the interpret-mode shard_map fallback
-    and the parity oracle's cross-check."""
+    and the parity oracle's cross-check. ``mem_lens`` [B] excludes each
+    row's memory columns >= its length from the softmax ENTIRELY (the
+    per-row raggedness contract of the stride kernel below)."""
     L = _num_layers(cell_params)
     emb = jnp.asarray(
         cell_params["word_embed"]["embedding"]
@@ -123,6 +126,13 @@ def _reference(cell_params, carry, token, memory, memory_proj, memory_mask):
     t = jnp.tanh(memory_proj.astype(jnp.float32)[None] + q[:, :, None, :])
     s = jnp.einsum("gbma,a->gbm", t, v)
     s = jnp.where(memory_mask[None] > 0, s, NEG)
+    if mem_lens is not None:
+        # rows keep >= 1 column so a fully-excluded row cannot NaN the
+        # softmax (an unoccupied serving lane degrades to w=[1, 0, ..] over
+        # zeroed memory — finite, and its frozen outputs never show it)
+        lens = jnp.maximum(mem_lens.astype(jnp.int32), 1)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(col < lens[None, :, None], s, -jnp.inf)
     w = jax.nn.softmax(s, axis=-1)
     ctx = jnp.einsum("gbm,bme->gbe", w, memory.astype(jnp.float32))
     x = jnp.concatenate([emb, ctx], axis=-1)
@@ -426,7 +436,7 @@ def _stride_kernel(*refs, num_layers: int, m_true: int, V: int, S: int,
     L = num_layers
     it = iter(refs)
     t0_ref, nact_ref = next(it), next(it)
-    emb0_ref, fin0_ref = next(it), next(it)
+    emb0_ref, fin0_ref, lens_ref = next(it), next(it), next(it)
     carry_refs = [(next(it), next(it)) for _ in range(L)]
     mem_ref, proj_ref, mask_ref = next(it), next(it), next(it)
     wq_ref, bq_ref, v_ref = next(it), next(it), next(it)
@@ -478,8 +488,13 @@ def _stride_kernel(*refs, num_layers: int, m_true: int, V: int, S: int,
         t = jnp.tanh(proj_ref[:].astype(jnp.float32) + q[:, None, :])
         sc = jnp.sum(t * v_ref[0].astype(jnp.float32)[None, None, :], axis=-1)
         sc = jnp.where(mask_ref[:] > 0, sc, NEG)
+        # per-ROW raggedness: each row's memory columns past ITS length
+        # leave the softmax entirely (serving's paged gathers are ragged
+        # per request; exp underflow makes the exclusion bit-exact vs the
+        # -1e9 masking a padded-slab layout would apply — see module
+        # docstring). Uniform-length callers pass lens == m_true per row.
         mcol = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
-        sc = jnp.where(mcol < m_true, sc, -jnp.inf)
+        sc = jnp.where(mcol < lens_ref[:], sc, -jnp.inf)
         m = jnp.max(sc, axis=-1, keepdims=True)
         p = jnp.exp(sc - m)
         w = p / jnp.sum(p, axis=-1, keepdims=True)
@@ -598,7 +613,7 @@ def _stride_kernel(*refs, num_layers: int, m_true: int, V: int, S: int,
 
 def _reference_stride(cell_params, carry, token, finished, memory,
                       memory_proj, memory_mask, noise, t0, *, steps: int,
-                      temperature: float, min_len: int):
+                      temperature: float, min_len: int, mem_lens=None):
     """The stride kernel as a plain-jnp composite: S chained `_reference`
     steps with the driving loop's exact selection semantics (first-max
     argmax on lane 0, Gumbel-max on lanes 1..K from the provided noise,
@@ -607,7 +622,8 @@ def _reference_stride(cell_params, carry, token, finished, memory,
     toks, lps = [], []
     for s in range(steps):
         carry, logits = _reference(
-            cell_params, carry, token, memory, memory_proj, memory_mask
+            cell_params, carry, token, memory, memory_proj, memory_mask,
+            mem_lens=mem_lens,
         )
         neg = jnp.full_like(logits[..., :1], NEG)
         logits = (
@@ -632,7 +648,7 @@ def _reference_stride(cell_params, carry, token, finished, memory,
 
 
 def _stride_call(cell_params, carry, emb0, finished, memory, memory_proj,
-                 memory_mask, noise, t0, n_active, *, S: int,
+                 memory_mask, noise, t0, n_active, mem_lens, *, S: int,
                  temperature: float, min_len: int, block_b: int,
                  block_v: int, interpret: bool):
     L = _num_layers(cell_params)
@@ -655,6 +671,16 @@ def _stride_call(cell_params, carry, emb0, finished, memory, memory_proj,
     emb0p = _pad_to(emb0, 1, block_b)
     # padded rows are born finished: their outputs freeze to PAD/0
     fin0p = _pad_to(finished.astype(jnp.int32), 1, block_b, value=1)
+    # per-row memory lengths (serving's ragged paged gathers); uniform M
+    # when the caller passes none. Clamped to >= 1 so a zero-length row
+    # (unoccupied serving lane, padding) keeps a finite softmax — its
+    # frozen outputs never observe the uniform-over-one-zero-slot weights
+    if mem_lens is None:
+        mem_lens = jnp.full((B,), M, jnp.int32)
+    lensp = _pad_to(
+        jnp.clip(mem_lens.astype(jnp.int32), 1, M)[:, None], 0, block_b,
+        value=1,
+    )
     carryp = [
         (_pad_to(c, 1, block_b), _pad_to(h, 1, block_b)) for c, h in carry
     ]
@@ -685,8 +711,10 @@ def _stride_call(cell_params, carry, emb0, finished, memory, memory_proj,
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, block_b), lambda i, g, s, vb: (g, i),
                      memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, 1), lambda i, g, s, vb: (i, 0),
+                     memory_space=pltpu.VMEM),
     ]
-    args += [emb0p, fin0p]
+    args += [emb0p, fin0p, lensp]
     for c, h in carryp:
         for arr in (c, h):
             in_specs.append(
@@ -797,7 +825,8 @@ def fused_decode_stride(cell_params, carry, token, finished, memory,
                         memory_proj, memory_mask, noise, t0, n_active=None,
                         *, steps: int, temperature: float = 1.0,
                         min_len: int = 0, num_layers: int | None = None,
-                        block_b: int = 32, block_v: int = 1024):
+                        block_b: int = 32, block_v: int = 1024,
+                        mem_lens=None):
     """S fused decode steps with in-kernel token selection.
 
     -> ``(new_carry, tokens [S, G, B] int32, logprobs [S, G, B] f32)``.
@@ -809,8 +838,13 @@ def fused_decode_stride(cell_params, carry, token, finished, memory,
     ``decoding.common.gumbel_step_noise``), ``t0`` the global index of the
     stride's first step (for ``min_len`` masking), and ``n_active`` the
     compaction prefix length in batch columns (None/B = no compaction —
-    every block steps). Lane 0 is the greedy lane: untempered first-index
-    argmax, no noise consumed. Inference-only, like the per-step kernel.
+    every block steps). ``mem_lens`` [B] int32 gives each row's OWN memory
+    length: columns past it leave the attention softmax entirely — the
+    per-row raggedness contract serving's paged gathers rely on (a request
+    holding fewer pages attends over exactly its own slots; None = the
+    uniform M every offline caller has). Lane 0 is the greedy lane:
+    untempered first-index argmax, no noise consumed. Inference-only, like
+    the per-step kernel.
     """
     if num_layers is not None and num_layers != _num_layers(cell_params):
         raise ValueError(
@@ -841,12 +875,12 @@ def fused_decode_stride(cell_params, carry, token, finished, memory,
         return _reference_stride(
             cell_params, carry, token, finished, memory, memory_proj,
             memory_mask, noise, t0, steps=steps, temperature=temperature,
-            min_len=min_len,
+            min_len=min_len, mem_lens=mem_lens,
         )
     emb0 = jnp.asarray(cell_params["word_embed"]["embedding"])[token]
     return _stride_call(
         cell_params, carry, emb0, finished, memory, memory_proj, memory_mask,
-        noise, t0, n_active, S=steps, temperature=temperature,
+        noise, t0, n_active, mem_lens, S=steps, temperature=temperature,
         min_len=min_len, block_b=block_b, block_v=block_v,
         interpret=interpret,
     )

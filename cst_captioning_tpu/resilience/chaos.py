@@ -34,6 +34,12 @@ Fault kinds:
   :class:`~cst_captioning_tpu.resilience.health.HealthMonitor` (tombstone +
   synchronous loss flag): one host of the cluster was preempted while this
   one survived — the elastic drain/degraded-continuation trigger.
+- ``"serving_preempt"`` — request a drain of the active
+  :class:`~cst_captioning_tpu.serving.engine.CaptionService` (fire at
+  ``serving.step``): the serving loop finishes in-flight strides, refuses
+  new admissions, and persists the queue + page-table snapshot — the
+  SIGTERM/peer-loss path, triggered deterministically. The recovery test
+  replays the drained queue and pins bit-identical tokens.
 
 Injection points currently compiled in:
 
@@ -48,6 +54,7 @@ Injection points currently compiled in:
 ``ckpt.state_written``  after ``state.msgpack`` hits the tmp dir
 ``ckpt.pre_replace``    tmp dir complete + fsync'd, final rename not yet done
 ``reward.call``    inside the retried RL reward invocation
+``serving.step``   serving admission loop, once per iteration (main thread)
 =================  =========================================================
 """
 
@@ -100,7 +107,7 @@ class Fault:
 
     _KINDS = ("kill", "preempt", "io_error", "nan", "slow", "slow_h2d",
               "partial_h2d", "wedged_prefetch", "enospc_rotation",
-              "partial_preempt")
+              "partial_preempt", "serving_preempt")
 
     def __post_init__(self):
         if self.kind not in self._KINDS:
@@ -187,6 +194,12 @@ class FaultPlan:
                 from cst_captioning_tpu.resilience import health
 
                 health.simulate_peer_loss(f.host)
+            elif f.kind == "serving_preempt":
+                # lazy import: serving pulls jax in; chaos must stay
+                # importable from jax-free contexts (cli.obs_report)
+                from cst_captioning_tpu.serving import engine as serving
+
+                serving.request_drain("chaos_serving_preempt")
             elif f.kind in ("slow", "slow_h2d", "wedged_prefetch"):
                 time.sleep(f.delay)
             elif f.kind == "nan":

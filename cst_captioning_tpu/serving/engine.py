@@ -1,0 +1,944 @@
+"""CaptionService: always-on caption serving with continuous batching.
+
+The admission/batch-former loop runs the PR 5 stride machinery as a
+*service*: a fixed pool of ``capacity`` decode lanes steps S-step strides
+forever, and between strides — exactly where finished-lane compaction
+already re-packs columns — finished requests leave their lanes and queued
+requests slot in. The stride program never learns about requests: like the
+offline loop, it sees a dense active prefix (host-built permutation +
+``n_active``), gathered encoder pages, and per-row noise. Continuous
+batching is therefore *structurally* the offline decode with a different
+column occupancy per stride, which is what makes the parity pin possible:
+
+**Per-request determinism.** Every request decodes on its OWN RNG streams
+— ``fold_in(fold_in(key(seed), k), t)`` with the request's *local* step t —
+and its encoder output comes from a batched admission-group encode whose
+rows it owns alone. Per-row encoder AND decode math is batch-composition
+independent (each row's matmul/softmax reads only its own row) and
+padding-width independent (masked memory slots contribute exact-zero
+softmax weight), so a request admitted mid-flight into an arbitrary lane
+emits token- and logprob-BIT-identical output to
+the same clip decoded offline through ``decoding.fused.fused_decode``
+(pinned by tests/test_serving.py). K sampled lanes ride along as *Noisy
+Parallel Approximate Decoding* (arXiv:1605.03835): the served caption is
+the best-scoring lane (greedy included), an anytime quality knob that
+costs only lane width.
+
+**Zero-sync loop discipline (GL001-clean).** All device work is dispatched
+through jitted closures; every host<->device crossing is explicit — one
+``jax.device_put`` batch per stride for the small host-built inputs (page
+table, permutation, lens) and ONE explicit ``jax.device_get`` per stride
+for the emissions the host must act on (tokens/logprobs/finished — the
+admission decision and the response payload ARE host data; serving's
+per-stride readback is the deliberate, amortized sync point, not an
+accident). Nothing else crosses implicitly: the loop body holds under
+``jax.transfer_guard("disallow")`` (tests/test_serving.py sanitize test).
+
+**Drain.** SIGTERM, a detected peer loss (resilience/health.py), or the
+seeded ``serving_preempt`` chaos fault stop the loop at the next stride
+boundary: in-flight strides finish, new admissions are refused, and the
+queue (pending + in-flight request payloads) plus the page-table snapshot
+persist to the snapshot dir. :func:`load_snapshot` replays the drained
+queue through a fresh service and — per-request determinism again — yields
+bit-identical tokens (pinned by the recovery test).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cst_captioning_tpu.config.config import BOS_ID, EOS_ID, PAD_ID
+from cst_captioning_tpu.decoding.common import (
+    forbid_special,
+    gumbel_step_noise,
+    lane_decode_step,
+    selected_logprob,
+    step_outputs,
+)
+from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
+from cst_captioning_tpu import obs
+from cst_captioning_tpu.obs.flops import enc_and_per_tok_flops
+from cst_captioning_tpu.resilience import chaos
+from cst_captioning_tpu.resilience.preempt import PreemptionHandler
+from cst_captioning_tpu.serving.pages import OutOfPages, PageBank
+
+
+@dataclass(frozen=True)
+class ClipRequest:
+    """One caption request: unbatched features ``[F, D]`` per modality,
+    per-frame masks ``[F]``, and the request's OWN rng seed (the whole
+    decode is a deterministic function of this payload — replay = rerun)."""
+
+    req_id: str
+    feats: dict[str, np.ndarray]
+    masks: dict[str, np.ndarray]
+    seed: int = 0
+    arrival_s: float = 0.0
+
+    @property
+    def num_frames(self) -> int:
+        return int(next(iter(self.feats.values())).shape[0])
+
+
+@dataclass
+class CaptionResult:
+    req_id: str
+    tokens: np.ndarray        # [1+K, T] int32 — lane 0 greedy, like fused.py
+    logprobs: np.ndarray      # [1+K, T] f32 untempered model logprobs
+    best_lane: int            # NPAD pick: argmax sum-logprob over lanes
+    caption_ids: list[int]    # best lane up to (excluding) EOS
+    caption: str | None       # detokenized when the service has a vocab
+    latency_s: float          # arrival -> completion (queue wait included)
+    phases: dict[str, float]  # queue_wait / encode / decode / detok seconds
+
+
+@dataclass
+class ServeReport:
+    results: dict[str, CaptionResult] = field(default_factory=dict)
+    drained: bool = False
+    drain_reason: str = ""
+    snapshot_dir: str | None = None
+    wall_s: float = 0.0
+    submitted: int = 0
+    completed: int = 0
+    strides: int = 0
+
+
+@dataclass
+class _Ticket:
+    req: ClipRequest
+    slot: int = -1
+    t: int = 0                      # local decode step (host mirror)
+    tok: np.ndarray | None = None   # [G, T] accumulation buffers
+    lp: np.ndarray | None = None
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_encoded: float = 0.0
+
+
+# the active service (drain target of the serving_preempt chaos fault and
+# the module-level request_drain() entry point)
+_ACTIVE: "CaptionService | None" = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def request_drain(reason: str = "requested") -> None:
+    """Ask the active service to drain (chaos ``serving_preempt`` hook)."""
+    with _ACTIVE_LOCK:
+        svc = _ACTIVE
+    if svc is None:
+        raise RuntimeError(
+            "serving_preempt fired with no active CaptionService — the "
+            "fault models a preemption of the serving loop"
+        )
+    svc.drain(reason)
+
+
+class CaptionService:
+    """Continuous-batching caption service over one model + params.
+
+    ``capacity`` decode lanes, ``num_rollouts`` K sampled lanes per request
+    (lane 0 is always the greedy lane), ``stride`` steps per dispatched
+    chunk (defaults to ``model.cfg.decode_stride``). The paged encoder bank
+    holds ``num_pages`` pages of ``page_size`` memory slots; admission
+    backpressures on page exhaustion. ``frame_bucket`` pads each clip's
+    frame axis up to the next bucket multiple (<= ``cfg.max_frames``) so
+    ragged clips hold fewer pages — decode output is padding-width
+    invariant (module docstring), so the bucket is a pure memory knob.
+    """
+
+    def __init__(
+        self,
+        model: CaptionModel,
+        params,
+        vocab=None,
+        *,
+        capacity: int = 8,
+        num_rollouts: int = 2,
+        temperature: float = 1.0,
+        max_len: int | None = None,
+        min_len: int = 0,
+        stride: int | None = None,
+        page_size: int | None = None,
+        num_pages: int | None = None,
+        frame_bucket: int | None = None,
+        kernel_block_b: int = 1,
+        admit_group: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        cfg = model.cfg
+        self.model = model
+        self.params = params
+        self.vocab = vocab
+        self.B = int(capacity)
+        self.K = int(num_rollouts)
+        self.G = 1 + self.K
+        self.T = int(max_len or cfg.max_len)
+        self.temperature = float(temperature)
+        self.min_len = int(min_len)
+        self.S = max(1, min(
+            int(stride if stride is not None
+                else getattr(cfg, "decode_stride", 8)),
+            self.T,
+        ))
+        self.use_kernel = getattr(cfg, "decode_impl", "xla") == "pallas"
+        if self.use_kernel and self.min_len > 0:
+            raise ValueError(
+                "decode_impl='pallas' serving does not support min_len > 0 "
+                "(the stride kernel's min-len mask is stride-global, not "
+                "per-row) — use the XLA decode path"
+            )
+        if self.use_kernel and self.K < 1:
+            raise ValueError(
+                "decode_impl='pallas' serving needs num_rollouts >= 1 "
+                "(the stride kernel requires the (1+K)-lane layout)"
+            )
+        if self.B < 1:
+            raise ValueError(f"capacity {capacity} must be >= 1")
+        self.n_mod = len(cfg.modalities)
+        self.frame_bucket = int(frame_bucket or cfg.max_frames)
+        if not (1 <= self.frame_bucket <= cfg.max_frames):
+            raise ValueError(
+                f"frame_bucket {self.frame_bucket} must be in "
+                f"[1, max_frames={cfg.max_frames}]"
+            )
+        m_max = self.n_mod * cfg.max_frames
+        page = int(page_size or max(self.n_mod * self.frame_bucket, 1))
+        pages_per_row = -(-m_max // page)
+        if num_pages is None:
+            # default pool: every lane can hold a max-length clip (the
+            # padded-slab equivalent); size it DOWN to see backpressure
+            num_pages = self.B * pages_per_row
+        self.bank = PageBank(num_pages, page)
+        self.table_width = pages_per_row
+        self.W = pages_per_row * page     # gathered memory width per row
+
+        # admission-group encode width. 1 (default) = one encoder pass per
+        # request, which is what makes a served request bit-identical to
+        # its offline B=1 decode at EVERY dtype. >1 batches same-bucket
+        # admission encodes into one pass (less admission wall under
+        # arrival waves) — still bit-exact where the encoder gemm is
+        # row-stable (f32 on CPU/TPU, pinned by test), but bf16-on-CPU
+        # encoder gemms are batch-shape sensitive, so the parity contract
+        # only covers the default
+        self.admit_group = max(int(admit_group), 1)
+        # kernel batch-block width. 1 (default) = every lane is its own
+        # block: the kernel's block-granular skips become PER-ROW skips
+        # (finished rows and the compaction prefix die row by row), and each
+        # row computes in exactly the [1, ..] block shape an offline B=1
+        # decode uses — which is what makes serving-pallas bit-identical to
+        # offline-pallas per request (wider blocks change the matmul
+        # accumulation shape; on TPU raise this toward the sublane width
+        # and accept fraction-grade parity, like the offline kernel)
+        self.kernel_block_b = int(kernel_block_b)
+        self._queue: deque[ClipRequest] = deque()
+        self._tickets: dict[str, _Ticket] = {}
+        self._inflight: dict[int, _Ticket] = {}   # slot -> ticket
+        self._free_slots: deque[int] = deque(range(self.B))
+        self._state = None                        # lazy device lane state
+        self._drain = threading.Event()
+        self._drain_reason = ""
+        self.clock = clock
+        self._encode_fns: dict[int, Callable] = {}
+        self._admit_fn = None
+        self._stride_fn = self._build_stride_fn()
+        # seed -> raw key data, jitted: `jax.random.key(seed)` EAGER would
+        # stage the seed scalar implicitly (the transfer-guard test's whole
+        # point); inside jit the seed arrives as an explicit device_put arg
+        self._key_fn = jax.jit(
+            lambda s: jax.random.key_data(jax.random.key(s))
+        )
+        # analytic per-token / encode FLOPs for the obs MFU counters
+        feat_dims = tuple(d for _, d in cfg.modalities)
+        self._enc_flops, self._tok_flops = enc_and_per_tok_flops(
+            cfg.max_frames, cfg.d_embed, cfg.d_hidden, cfg.d_att,
+            cfg.vocab_size, feat_dims, cfg.num_layers,
+        )
+
+    # ---- public API ---------------------------------------------------------
+
+    def submit(self, req: ClipRequest) -> None:
+        if req.req_id in self._tickets:
+            raise ValueError(f"duplicate req_id {req.req_id!r}")
+        if req.num_frames < 1 or req.num_frames > self.model.cfg.max_frames:
+            raise ValueError(
+                f"request {req.req_id!r} has {req.num_frames} frames "
+                f"(need 1..{self.model.cfg.max_frames})"
+            )
+        if not 0 <= req.seed < 2**31:
+            # the seed travels as an int32 scalar; out-of-range values
+            # would silently change the request's RNG streams vs the
+            # offline `jax.random.key(seed)` spelling
+            raise ValueError(
+                f"request {req.req_id!r} seed {req.seed} outside [0, 2^31)"
+            )
+        self._tickets[req.req_id] = _Ticket(req=req)
+        self._queue.append(req)
+        obs.counter("serving.requests_submitted").inc()
+
+    def drain(self, reason: str = "requested") -> None:
+        """Stop at the next stride boundary: finish in-flight strides,
+        refuse new admissions, snapshot the queue (thread/signal-safe)."""
+        self._drain_reason = self._drain_reason or reason
+        self._drain.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._drain.is_set()
+
+    def serve(
+        self,
+        requests: Iterable[ClipRequest] = (),
+        *,
+        snapshot_dir: str | None = None,
+        realtime: bool = False,
+        idle_wait_s: float = 0.002,
+    ) -> ServeReport:
+        """Run the admission/decode loop until the queue drains (or a drain
+        is requested). ``realtime=True`` honors each request's ``arrival_s``
+        against the wall clock (the bench's open-loop mode); otherwise every
+        submitted request is immediately admissible."""
+        global _ACTIVE
+        for req in sorted(requests, key=lambda r: r.arrival_s):
+            self.submit(req)
+        report = ServeReport(submitted=len(self._tickets))
+        t0 = self.clock()
+        now = lambda: self.clock() - t0  # noqa: E731
+        with _ACTIVE_LOCK:
+            prev_active = _ACTIVE
+            _ACTIVE = self
+        pre = PreemptionHandler().install()
+        try:
+            while True:
+                chaos.visit("serving.step")
+                if pre.requested:
+                    self.drain("sigterm")
+                mon = _health_monitor()
+                if mon is not None and mon.peer_lost:
+                    self.drain("peer_loss")
+                if self.draining:
+                    # stride-boundary drain: the dispatched stride already
+                    # finished (we only reach here between strides); both
+                    # in-flight AND pending requests persist to the
+                    # snapshot and replay from scratch bit-identically
+                    break
+                self._admit_arrived(now, realtime)
+                if not self._inflight:
+                    if not self._queue:
+                        break
+                    # queued work not yet arrived (realtime) or blocked on
+                    # pages freed only by completions that cannot come —
+                    # the former waits, the latter is a sizing error
+                    if not realtime:
+                        raise OutOfPages(
+                            "queue is non-empty but nothing can be "
+                            "admitted: a single request needs more pages "
+                            "than the whole pool"
+                        )
+                    time.sleep(idle_wait_s)
+                    continue
+                self._run_stride(report, now)
+            report.drained = self.draining
+            report.drain_reason = self._drain_reason
+            if self.draining:
+                report.snapshot_dir = self._write_snapshot(snapshot_dir)
+                obs.event(
+                    "serving_drain", reason=self._drain_reason,
+                    pending=len(self._queue), inflight=len(self._inflight),
+                    snapshot=report.snapshot_dir,
+                )
+                obs.counter("serving.drains").inc()
+                # release the drained working set AFTER the snapshot
+                # captured the page table (the object stays reusable)
+                for slot in sorted(self._inflight):
+                    ticket = self._inflight.pop(slot)
+                    self.bank.free(ticket.req.req_id)
+                    self._free_slots.append(slot)
+                    self._tickets.pop(ticket.req.req_id, None)
+                for req in self._queue:
+                    self._tickets.pop(req.req_id, None)
+                self._queue.clear()
+        finally:
+            pre.uninstall()
+            with _ACTIVE_LOCK:
+                _ACTIVE = prev_active
+        report.wall_s = now()
+        report.completed = len(report.results)
+        return report
+
+    def stride_cost(self) -> dict | None:
+        """XLA HLO cost analysis of ONE compiled stride program
+        (``obs/flops.compiled_cost``) — the serving MFU ledger's
+        compiled-program FLOPs source, analytic fallback when None.
+        Available once the service has admitted at least one request (the
+        pools and lane state exist then)."""
+        from cst_captioning_tpu.obs.flops import compiled_cost
+
+        if self._state is None or self.bank.mem is None:
+            return None
+        B = self.B
+        perm = np.arange(B, dtype=np.int32)
+        return compiled_cost(
+            self._stride_fn, self.params,
+            (self.bank.mem, self.bank.proj, self.bank.mask),
+            np.zeros((B, self.table_width), np.int32),
+            np.zeros((B,), np.int32), perm, perm, np.int32(B), self._state,
+        )
+
+    # ---- admission ----------------------------------------------------------
+
+    def _admit_arrived(self, now, realtime: bool) -> None:
+        # collect every currently-admissible request (a free lane AND
+        # enough free pages), grouped by frame bucket — each group encodes
+        # as ONE batched pass. Per-row encoder math is batch-composition
+        # independent (module docstring), so batching the admission encode
+        # changes no bits, only the wall clock a serialized-B=1 admission
+        # loop would burn (the static policy amortizes its encoder over the
+        # batch; the continuous former must too, or it spots the comparison
+        # an encoder pass per request)
+        groups: dict[int, list[ClipRequest]] = {}
+        free = len(self._free_slots)
+        reserved = 0
+        while self._queue and free:
+            req = self._queue[0]
+            if realtime and req.arrival_s > now():
+                break
+            n_pages = self.bank.pages_for(self.n_mod * self._padded_frames(req))
+            if self.bank.free_pages - reserved < n_pages:
+                obs.counter("serving.admission_blocked_pages").inc()
+                break
+            self._queue.popleft()
+            groups.setdefault(self._padded_frames(req), []).append(req)
+            reserved += n_pages
+            free -= 1
+        for F, reqs in groups.items():
+            for i in range(0, len(reqs), self.admit_group):
+                chunk = reqs[i:i + self.admit_group]
+                with obs.span("serving.admit", requests=len(chunk)):
+                    self._admit_group(F, chunk, now)
+        if groups or self._queue:
+            obs.gauge("serving.queue_depth").set(len(self._queue))
+
+    def _padded_frames(self, req: ClipRequest) -> int:
+        b = self.frame_bucket
+        return min(-(-req.num_frames // b) * b, self.model.cfg.max_frames)
+
+    def _admit_group(self, F: int, reqs: list[ClipRequest], now) -> None:
+        t_admit = now()
+        t_enc0 = time.perf_counter()
+        with obs.span("serving.encode", requests=len(reqs)):
+            enc = self._encode_batch(reqs, F)
+        enc_s = (time.perf_counter() - t_enc0) / len(reqs)
+        m_len = self.n_mod * F
+        for i, req in enumerate(reqs):
+            ticket = self._tickets[req.req_id]
+            ticket.t_submit = ticket.t_submit or req.arrival_s
+            ticket.t_admit = t_admit
+            enc_i = jax.tree.map(lambda x: x[i:i + 1], enc)
+            pages = self.bank.alloc(req.req_id, m_len)
+            self.bank.store(
+                pages, enc_i.memory, enc_i.memory_proj, enc_i.memory_mask
+            )
+            ticket.t_encoded = now()
+            slot = self._free_slots.popleft()
+            ticket.slot = slot
+            ticket.tok = np.full((self.G, self.T), PAD_ID, np.int32)
+            ticket.lp = np.zeros((self.G, self.T), np.float32)
+            self._inflight[slot] = ticket
+            self._ensure_state(enc_i)
+            key_raw = self._key_fn(jax.device_put(np.int32(req.seed)))
+            self._state = self._admit_fn(
+                self._state, jax.device_put(np.int32(slot)), enc_i.carry,
+                key_raw,
+            )
+            obs.counter("serving.requests_admitted").inc()
+            obs.counter("flops.serving.encode").inc(self._enc_flops)
+            obs.histogram("serving.queue_wait_seconds").observe(
+                max(ticket.t_admit - ticket.t_submit, 0.0)
+            )
+            obs.histogram("serving.encode_seconds").observe(enc_s)
+        obs.gauge("serving.slots_in_use").set(len(self._inflight))
+        obs.gauge("serving.pages_in_use").set(self.bank.pages_in_use)
+
+    def _encode_batch(self, reqs: list[ClipRequest], F: int) -> EncoderOutput:
+        """One batched encoder pass for an admission group. The batch dim
+        pads to the next power of two (repeating row 0; surplus rows are
+        discarded) so compile count stays O(log capacity) per frame bucket
+        instead of one program per group size."""
+        n = len(reqs)
+        npad = 1
+        while npad < n:
+            npad *= 2
+        fn = self._encode_fns.get((F, npad))
+        if fn is None:
+            model = self.model
+            fn = jax.jit(
+                lambda p, f, m: model.apply(
+                    p, f, m, method=CaptionModel.encode
+                )
+            )
+            self._encode_fns[(F, npad)] = fn
+        feats, masks = {}, {}
+        for name, _ in self.model.cfg.modalities:
+            rows, mrows = [], []
+            for req in reqs:
+                x = np.asarray(req.feats[name], np.float32)
+                mk = np.asarray(req.masks[name], np.float32)
+                pad = F - x.shape[0]
+                rows.append(np.pad(x, ((0, pad), (0, 0))))
+                mrows.append(np.pad(mk, ((0, pad),)))
+            rows += rows[:1] * (npad - n)
+            mrows += mrows[:1] * (npad - n)
+            feats[name] = jax.device_put(np.stack(rows))
+            masks[name] = jax.device_put(np.stack(mrows))
+        return fn(self.params, feats, masks)
+
+    # ---- device lane state --------------------------------------------------
+
+    def _ensure_state(self, enc: EncoderOutput) -> None:
+        if self._state is not None:
+            return
+        G, B = self.G, self.B
+        carry = tuple(
+            (
+                jnp.zeros((G, B) + c.shape[1:], c.dtype),
+                jnp.zeros((G, B) + h.shape[1:], h.dtype),
+            )
+            for c, h in enc.carry
+        )
+        # key-data layout probed abstractly (eval_shape: no device values,
+        # no transfers — the impl-dependent raw width is all we need)
+        key_aval = jax.eval_shape(
+            lambda: jax.random.key_data(jax.random.key(0))
+        )
+        self._state = (
+            carry,
+            jnp.full((G, B), BOS_ID, jnp.int32),
+            jnp.ones((G, B), bool),        # empty lanes are born finished
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,) + key_aval.shape, key_aval.dtype),
+        )
+        L = len(enc.carry)
+
+        def admit(state, col, enc_carry, key_raw):
+            carry, token, finished, t_local, keys = state
+            new_carry = tuple(
+                (
+                    c.at[:, col].set(
+                        jnp.broadcast_to(ec[0], (G,) + ec.shape[1:])
+                    ),
+                    h.at[:, col].set(
+                        jnp.broadcast_to(eh[0], (G,) + eh.shape[1:])
+                    ),
+                )
+                for (c, h), (ec, eh) in zip(carry, enc_carry)
+            )
+            return (
+                new_carry,
+                token.at[:, col].set(BOS_ID),
+                finished.at[:, col].set(False),
+                t_local.at[col].set(0),
+                keys.at[col].set(key_raw),
+            )
+
+        assert L == len(carry)
+        self._admit_fn = jax.jit(admit, donate_argnums=(0,))
+
+    # ---- the stride ---------------------------------------------------------
+
+    def _build_stride_fn(self):
+        model, params_model = self.model, None  # params passed per call
+        B, G, K, S, T, W = self.B, self.G, self.K, self.S, self.T, self.W
+        V = model.cfg.vocab_size
+        temp, min_len = self.temperature, self.min_len
+        use_kernel = self.use_kernel
+        num_layers = model.cfg.num_layers
+        kernel_block_b = self.kernel_block_b
+
+        def row_noise(key_raw, t_b):
+            """[S, K, V] Gumbel noise on THIS request's offline streams:
+            ``gumbel(fold_in(fold_in(key, k), t), (1, V))`` — the exact
+            call shape ``fused_decode`` makes for a B=1 batch, so the bits
+            match the offline decode draw for draw. Steps past T clamp to
+            T-1 like ``rollout_step_keys`` (the overhang draws only ever
+            feed discarded emissions)."""
+            key = jax.random.wrap_key_data(key_raw)
+            ks = jax.vmap(lambda k: jax.random.fold_in(key, k))(
+                jnp.arange(K)
+            )
+
+            def step_noise(t):
+                ks_t = jax.vmap(lambda kk: jax.random.fold_in(kk, t))(ks)
+                return gumbel_step_noise(ks_t, (1, V), jnp.float32)[:, 0]
+
+            ts = jnp.minimum(t_b + jnp.arange(S), T - 1)
+            return jax.vmap(step_noise)(ts)
+
+        def stride(params, pools, table, lens, perm, inv, n_active, state):
+            carry, token, finished, t_local, keys = state
+            take1 = lambda x: jnp.take(x, perm, axis=1)  # noqa: E731
+            carry_c = jax.tree.map(take1, carry)
+            token_c, fin_c = take1(token), take1(finished)
+            t_c = jnp.take(t_local, perm)
+            keys_c = jnp.take(keys, perm, axis=0)
+            mem_pool, proj_pool, mask_pool = pools
+            flat = jnp.take(table, perm, axis=0).reshape(-1)
+            mem = jnp.take(mem_pool, flat, axis=0).reshape(
+                B, W, mem_pool.shape[-1]
+            )
+            proj = jnp.take(proj_pool, flat, axis=0).reshape(
+                B, W, proj_pool.shape[-1]
+            )
+            mask = jnp.take(mask_pool, flat, axis=0).reshape(B, W)
+            lens_c = jnp.take(lens, perm)
+            enc_c = EncoderOutput(mem, proj, mask, ())
+            if K:
+                noise = jnp.transpose(
+                    jax.vmap(row_noise)(keys_c, t_c), (1, 2, 0, 3)
+                )  # [S, K, B, V]
+            else:
+                noise = jnp.zeros((S, 0, B, V), jnp.float32)
+
+            if use_kernel:
+                from cst_captioning_tpu.ops.decode_pallas import (
+                    fused_decode_stride,
+                )
+
+                carry_c, toks, lps = fused_decode_stride(
+                    params["params"]["cell"], carry_c, token_c, fin_c,
+                    enc_c.memory, enc_c.memory_proj, enc_c.memory_mask,
+                    noise, jnp.int32(0), n_active, steps=S,
+                    temperature=temp, min_len=0, num_layers=num_layers,
+                    mem_lens=lens_c, block_b=kernel_block_b,
+                )
+                fin_c = fin_c | jnp.any(toks == EOS_ID, axis=0)
+                token_c = toks[-1]
+            else:
+                def step(st, s):
+                    carry_s, token_s, fin_s = st
+                    carry_s, logits = lane_decode_step(
+                        model, params, carry_s, token_s, enc_c
+                    )
+                    logits = forbid_special(logits)
+                    if min_len > 0:
+                        blocked = logits.at[..., EOS_ID].set(-1.0e9)
+                        logits = jnp.where(
+                            ((t_c + s) < min_len)[None, :, None],
+                            blocked, logits,
+                        )
+                    g_nxt = jnp.argmax(logits[0], axis=-1)
+                    tl = logits[1:] / temp
+                    s_nxt = jnp.argmax(tl + noise[s], axis=-1)
+                    nxt = jnp.concatenate(
+                        [g_nxt[None], s_nxt], axis=0
+                    ).astype(jnp.int32)
+                    lp = selected_logprob(logits, nxt)
+                    nxt, lp, fin_s = step_outputs(nxt, lp, fin_s)
+                    return (carry_s, nxt, fin_s), (nxt, lp)
+
+                (carry_c, token_c, fin_c), (toks, lps) = jax.lax.scan(
+                    step, (carry_c, token_c, fin_c), jnp.arange(S)
+                )
+
+            back1 = lambda x: jnp.take(x, inv, axis=1)  # noqa: E731
+            new_state = (
+                jax.tree.map(back1, carry_c),
+                back1(token_c),
+                back1(fin_c),
+                t_local + S,
+                keys,
+            )
+            return new_state, jnp.take(toks, inv, axis=2), jnp.take(
+                lps, inv, axis=2
+            )
+
+        return jax.jit(stride, donate_argnums=(7,))
+
+    def _run_stride(self, report: ServeReport, now) -> None:
+        active = sorted(self._inflight)
+        perm = np.fromiter(
+            (s for s in active), np.int32, len(active)
+        )
+        rest = np.fromiter(
+            (s for s in range(self.B) if s not in self._inflight),
+            np.int32, self.B - len(active),
+        )
+        perm = np.concatenate([perm, rest])
+        inv = np.argsort(perm, kind="stable").astype(np.int32)
+        owners = [None] * self.B
+        lens = np.zeros((self.B,), np.int32)
+        for slot, ticket in self._inflight.items():
+            owners[slot] = ticket.req.req_id
+            lens[slot] = self.bank.length(ticket.req.req_id)
+        table = self.bank.table(owners, self.table_width)
+        with obs.span("serving.stride", active=len(active)):
+            dev = jax.device_put(
+                (table, lens, perm, inv, np.int32(len(active)))
+            )
+            self._state, toks, lps = self._stride_fn(
+                self.params,
+                (self.bank.mem, self.bank.proj, self.bank.mask),
+                *dev, self._state,
+            )
+            # the per-stride sync point: ONE explicit readback of the small
+            # host-facing outputs (module docstring)
+            toks_np, lps_np, fin_np = jax.device_get(
+                (toks, lps, self._state[2])
+            )
+        report.strides += 1
+        obs.counter("serving.strides").inc()
+        obs.counter("flops.serving.stride").inc(
+            len(active) * self.G * self.S * self._tok_flops
+        )
+        for slot in active:
+            ticket = self._inflight[slot]
+            n = min(self.S, self.T - ticket.t)
+            ticket.tok[:, ticket.t:ticket.t + n] = toks_np[:n, :, slot].T
+            ticket.lp[:, ticket.t:ticket.t + n] = lps_np[:n, :, slot].T
+            ticket.t += n
+            if bool(fin_np[:, slot].all()) or ticket.t >= self.T:
+                self._complete(ticket, report, now)
+
+    def _complete(self, ticket: _Ticket, report: ServeReport, now) -> None:
+        with obs.span("serving.detok", req=ticket.req.req_id):
+            t_det0 = time.perf_counter()
+            lane_scores = ticket.lp.sum(axis=1)
+            best = int(np.argmax(lane_scores))
+            row = ticket.tok[best]
+            ids: list[int] = []
+            for tok in row:
+                tok = int(tok)
+                if tok in (EOS_ID, PAD_ID):
+                    break
+                ids.append(tok)
+            caption = self.vocab.decode(row) if self.vocab is not None else None
+            detok_s = time.perf_counter() - t_det0
+        t_done = now()
+        self._inflight.pop(ticket.slot)
+        self._free_slots.append(ticket.slot)
+        self.bank.free(ticket.req.req_id)
+        # evict the ticket: an always-on service must not grow state per
+        # served request (and a later request may legitimately reuse an id)
+        self._tickets.pop(ticket.req.req_id, None)
+        phases = {
+            "queue_wait": max(ticket.t_admit - ticket.t_submit, 0.0),
+            "encode": max(ticket.t_encoded - ticket.t_admit, 0.0),
+            "decode": max(t_done - ticket.t_encoded, 0.0),
+            "detok": detok_s,
+        }
+        latency = max(t_done - ticket.t_submit, 0.0)
+        report.results[ticket.req.req_id] = CaptionResult(
+            req_id=ticket.req.req_id,
+            tokens=ticket.tok,
+            logprobs=ticket.lp,
+            best_lane=best,
+            caption_ids=ids,
+            caption=caption,
+            latency_s=latency,
+            phases=phases,
+        )
+        obs.counter("serving.requests_completed").inc()
+        obs.gauge("serving.slots_in_use").set(len(self._inflight))
+        obs.gauge("serving.pages_in_use").set(self.bank.pages_in_use)
+        obs.histogram("serving.decode_seconds").observe(
+            phases["decode"]
+        )
+        obs.histogram("serving.detok_seconds").observe(detok_s)
+        obs.histogram("serving.latency_seconds").observe(latency)
+        obs.event(
+            "serving_request", req=ticket.req.req_id, latency_s=latency,
+            best_lane=best, steps=ticket.t, **{
+                f"{k}_s": v for k, v in phases.items()
+            },
+        )
+
+    # ---- drain persistence --------------------------------------------------
+
+    def _write_snapshot(self, snapshot_dir: str | None) -> str | None:
+        if snapshot_dir is None:
+            return None
+        os.makedirs(snapshot_dir, exist_ok=True)
+        # in-flight first (they were admitted earlier), then queue order —
+        # replay preserves the service order
+        drained: list[ClipRequest] = [
+            self._inflight[s].req for s in sorted(
+                self._inflight, key=lambda s: self._inflight[s].t_admit
+            )
+        ] + list(self._queue)
+        arrays: dict[str, np.ndarray] = {}
+        manifest = {
+            "requests": [],
+            "page_table": self.bank.snapshot(),
+            "in_flight_steps": {
+                t.req.req_id: t.t for t in self._inflight.values()
+            },
+            "drain_reason": self._drain_reason,
+        }
+        for i, req in enumerate(drained):
+            manifest["requests"].append({
+                "req_id": req.req_id,
+                "seed": req.seed,
+                "arrival_s": req.arrival_s,
+                "modalities": sorted(req.feats),
+            })
+            for name in req.feats:
+                arrays[f"{i}.feats.{name}"] = np.asarray(
+                    req.feats[name], np.float32
+                )
+                arrays[f"{i}.masks.{name}"] = np.asarray(
+                    req.masks[name], np.float32
+                )
+        for req in drained:
+            self._tickets.pop(req.req_id, None)
+        np.savez(os.path.join(snapshot_dir, "queue.npz"), **arrays)
+        tmp = os.path.join(snapshot_dir, ".manifest.json.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(snapshot_dir, "manifest.json"))
+        return snapshot_dir
+
+
+def load_snapshot(snapshot_dir: str) -> list[ClipRequest]:
+    """Drained queue -> requests, in the order the service would have run
+    them. Re-serving them through a fresh CaptionService yields bit-identical
+    tokens (per-request determinism; in-flight requests restart from step 0)."""
+    with open(os.path.join(snapshot_dir, "manifest.json"),
+              encoding="utf-8") as f:
+        manifest = json.load(f)
+    data = np.load(os.path.join(snapshot_dir, "queue.npz"))
+    out: list[ClipRequest] = []
+    for i, rec in enumerate(manifest["requests"]):
+        feats = {m: data[f"{i}.feats.{m}"] for m in rec["modalities"]}
+        masks = {m: data[f"{i}.masks.{m}"] for m in rec["modalities"]}
+        out.append(ClipRequest(
+            req_id=rec["req_id"], feats=feats, masks=masks,
+            seed=int(rec["seed"]), arrival_s=float(rec["arrival_s"]),
+        ))
+    return out
+
+
+def _health_monitor():
+    """The active elastic-health monitor, if resilience wiring started one
+    (lazy import: serving must not drag the health stack in by default)."""
+    from cst_captioning_tpu.resilience import health
+
+    return health.active_monitor()
+
+
+# ---- the static-batching reference policy -----------------------------------
+
+
+def static_batch_serve(
+    model: CaptionModel,
+    params,
+    requests: list[ClipRequest],
+    *,
+    capacity: int = 8,
+    num_rollouts: int = 2,
+    temperature: float = 1.0,
+    max_len: int | None = None,
+    min_len: int = 0,
+    vocab=None,
+    service_seed: int = 0,
+    realtime: bool = False,
+    clock: Callable[[], float] = time.monotonic,
+    idle_wait_s: float = 0.002,
+    decode_fn=None,
+) -> ServeReport:
+    """The policy continuous batching is benchmarked against: wait until
+    ``capacity`` requests are queued (or no more are coming), decode the
+    whole batch offline through ``fused_decode``, return everyone together.
+
+    Every request pays batch-formation wait plus the full batch's decode
+    (the slowest member gates all), which is exactly the latency-tail cost
+    the continuous engine removes. Same hardware, same model, same K lanes,
+    same NPAD best-lane selection — only the batching policy differs. The
+    batch shares one rng (requests are NOT per-request deterministic here;
+    this is the throughput baseline, not the parity subject).
+
+    Batches are FIXED-SHAPE: a final partial batch pads with repeats of its
+    first row (outputs discarded), so the whole run is one compiled program
+    — static batch servers run fixed shapes, that is the point of the
+    policy. ``decode_fn`` lets the bench pass a pre-warmed jitted decode so
+    neither policy's measurements pay compile time.
+    """
+    from cst_captioning_tpu.decoding.fused import fused_decode
+
+    T = int(max_len or model.cfg.max_len)
+    F = model.cfg.max_frames
+    pending = deque(sorted(requests, key=lambda r: r.arrival_s))
+    report = ServeReport(submitted=len(pending))
+    t0 = clock()
+    now = lambda: clock() - t0  # noqa: E731
+    decode = decode_fn or jax.jit(
+        lambda p, f, m, r: fused_decode(
+            model, p, f, m, r, num_rollouts=num_rollouts,
+            temperature=temperature, max_len=T, min_len=min_len,
+        )
+    )
+    batch_idx = 0
+    service_key = jax.random.key(service_seed)
+    while pending:
+        arrived = [r for r in pending if (not realtime)
+                   or r.arrival_s <= now()]
+        if len(arrived) < min(capacity, len(pending)):
+            # batch former: wait for a full batch while more is coming
+            time.sleep(idle_wait_s)
+            continue
+        batch = [pending.popleft() for _ in range(min(capacity,
+                                                      len(pending)))]
+        rows_pad = capacity - len(batch)
+        feats = {}
+        masks = {}
+        for name, _ in model.cfg.modalities:
+            rows, mrows = [], []
+            for req in batch:
+                x = np.asarray(req.feats[name], np.float32)
+                mk = np.asarray(req.masks[name], np.float32)
+                pad = F - x.shape[0]
+                rows.append(np.pad(x, ((0, pad), (0, 0))))
+                mrows.append(np.pad(mk, ((0, pad),)))
+            rows += rows[:1] * rows_pad
+            mrows += mrows[:1] * rows_pad
+            feats[name] = jax.device_put(np.stack(rows))
+            masks[name] = jax.device_put(np.stack(mrows))
+        rng = jax.random.fold_in(service_key, batch_idx)
+        batch_idx += 1
+        g, gl, s, sl = jax.device_get(
+            decode(params, feats, masks, rng)
+        )
+        t_done = now()
+        for i, req in enumerate(batch):
+            tok = np.concatenate([g[i][None], s[:, i]], axis=0)
+            lp = np.concatenate([gl[i][None], sl[:, i]], axis=0)
+            best = int(np.argmax(lp.sum(axis=1)))
+            ids: list[int] = []
+            for t in tok[best]:
+                t = int(t)
+                if t in (EOS_ID, PAD_ID):
+                    break
+                ids.append(t)
+            latency = max(t_done - (req.arrival_s if realtime else 0.0), 0.0)
+            report.results[req.req_id] = CaptionResult(
+                req_id=req.req_id, tokens=tok, logprobs=lp, best_lane=best,
+                caption_ids=ids,
+                caption=vocab.decode(tok[best]) if vocab is not None else None,
+                latency_s=latency,
+                phases={"queue_wait": 0.0, "encode": 0.0,
+                        "decode": latency, "detok": 0.0},
+            )
+    report.wall_s = now()
+    report.completed = len(report.results)
+    return report

@@ -1,0 +1,200 @@
+"""Paged HBM bank for ragged in-flight encoder outputs.
+
+Offline decode pads every clip's memory bank to ``[B, M_max, E]`` — fine
+when the batch lives for one program, wasteful when requests of wildly
+different lengths coexist for many strides: a 1-frame clip would pin the
+same HBM as a max-frame one for its whole lifetime. Here encoder outputs
+live in fixed-size **pages** (the Ragged Paged Attention memory layout,
+arXiv:2604.15464):
+
+- three device pools — ``mem [N, P, E]``, ``proj [N, P, A]``,
+  ``mask [N, P]`` — hold N pages of P memory slots each;
+- a **host-side free-list** hands pages out at admission and takes them
+  back at completion (allocation is pure Python — no device traffic);
+- a **page table** (host int32 ``[slots, pages_per_row]``) maps each decode
+  lane to its pages; the serving stride gathers the active lanes' pages
+  into the dense ``[B, W, E]`` layout the decode step consumes (one
+  ``jnp.take`` per pool — a device-side copy, no host sync);
+- **page 0 is the shared zero page**: mask 0 everywhere, so table padding
+  gathers slots the attention softmax excludes exactly (masked scores hit
+  ``-1e9`` and underflow to an exact 0 weight — the bit-exactness argument
+  in decoding/fused.py's compaction applies unchanged).
+
+A request holds ``ceil(M_r / P)`` pages for exactly its in-flight window,
+so the pool capacity bounds the *sum of active lengths*, not
+``slots * M_max`` — the admission loop backpressures on ``OutOfPages``
+instead of overcommitting HBM.
+
+Writes are one jitted donated scatter per admission (``pool.at[idx].set``);
+frees touch no device state (a freed page's stale floats are unobservable:
+nothing points at it until it is re-allocated and overwritten).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Hashable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class OutOfPages(RuntimeError):
+    """The pool cannot hold another request's pages right now (backpressure:
+    the admission loop keeps the request queued until completions free
+    pages — it must NOT treat this as a permanent rejection)."""
+
+
+class PageBank:
+    """Fixed-size page pool with host free-list + host page table.
+
+    ``num_pages`` counts usable pages EXCLUDING the reserved zero page
+    (page id 0); ``page_size`` is P, the memory slots per page. Device
+    pools allocate lazily at the first :meth:`store` (dims/dtypes come
+    from the first encoder output), so constructing a bank costs nothing.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 1 or page_size < 1:
+            raise ValueError(
+                f"need num_pages >= 1 and page_size >= 1, got "
+                f"{num_pages}, {page_size}"
+            )
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._free: deque[int] = deque(range(1, self.num_pages + 1))
+        self._owned: dict[Hashable, list[int]] = {}
+        self._lens: dict[Hashable, int] = {}
+        self.mem = None    # [N+1, P, E]
+        self.proj = None   # [N+1, P, A]
+        self.mask = None   # [N+1, P]
+        self._store_fns: dict[tuple[int, int], object] = {}
+        self.pages_hwm = 0  # high-water mark, for the obs gauge
+
+    # ---- host-side accounting ----------------------------------------------
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, m_len: int) -> int:
+        return -(-int(m_len) // self.page_size)
+
+    def can_fit(self, m_len: int) -> bool:
+        return self.pages_for(m_len) <= len(self._free)
+
+    def alloc(self, owner: Hashable, m_len: int) -> list[int]:
+        """Reserve pages for ``m_len`` memory slots; raises OutOfPages."""
+        if owner in self._owned:
+            raise ValueError(f"owner {owner!r} already holds pages")
+        n = self.pages_for(m_len)
+        if n > len(self._free):
+            raise OutOfPages(
+                f"{n} page(s) requested, {len(self._free)} free "
+                f"(pool {self.num_pages} x {self.page_size} slots)"
+            )
+        pages = [self._free.popleft() for _ in range(n)]
+        self._owned[owner] = pages
+        self._lens[owner] = int(m_len)
+        self.pages_hwm = max(self.pages_hwm, self.pages_in_use)
+        return pages
+
+    def free(self, owner: Hashable) -> None:
+        """Return an owner's pages to the free list (no device writes: stale
+        page contents are unreachable until re-allocation overwrites them)."""
+        for p in self._owned.pop(owner, ()):
+            self._free.append(p)
+        self._lens.pop(owner, None)
+
+    def owned(self, owner: Hashable) -> list[int]:
+        return list(self._owned.get(owner, ()))
+
+    def length(self, owner: Hashable) -> int:
+        return self._lens.get(owner, 0)
+
+    def table(self, owners: list[Hashable | None], width: int) -> np.ndarray:
+        """Page table rows for ``owners`` (None/unknown -> all zero pages),
+        padded to ``width`` pages with the zero page."""
+        out = np.zeros((len(owners), width), np.int32)
+        for i, owner in enumerate(owners):
+            pages = self._owned.get(owner, ()) if owner is not None else ()
+            if len(pages) > width:
+                raise ValueError(
+                    f"owner {owner!r} holds {len(pages)} pages > table "
+                    f"width {width}"
+                )
+            out[i, : len(pages)] = pages
+        return out
+
+    def snapshot(self) -> dict:
+        """JSON-ready accounting snapshot (the drain persistence payload)."""
+        return {
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "free": list(self._free),
+            "owned": {str(k): list(v) for k, v in self._owned.items()},
+            "lengths": {str(k): v for k, v in self._lens.items()},
+            "pages_hwm": self.pages_hwm,
+        }
+
+    # ---- device pools -------------------------------------------------------
+
+    def _ensure_pools(self, memory: jnp.ndarray, proj: jnp.ndarray) -> None:
+        if self.mem is not None:
+            return
+        P = self.page_size
+        E, A = memory.shape[-1], proj.shape[-1]
+        # +1 row: page 0, the shared always-zero page table-padding gathers
+        self.mem = jnp.zeros((self.num_pages + 1, P, E), memory.dtype)
+        self.proj = jnp.zeros((self.num_pages + 1, P, A), proj.dtype)
+        self.mask = jnp.zeros((self.num_pages + 1, P), jnp.float32)
+
+    def store(self, pages: list[int], memory: jnp.ndarray, proj: jnp.ndarray,
+              mask: jnp.ndarray) -> None:
+        """Scatter one encoder output (``[1, M, *]`` leaves) into ``pages``.
+
+        One jitted donated scatter per distinct (n_pages, M) shape — the
+        pools update in place instead of double-buffering. The M -> n*P
+        pad rides inside the same program (mask pads with 0, so padded
+        slots are excluded from every later softmax).
+        """
+        self._ensure_pools(memory, proj)
+        n = len(pages)
+        M = int(memory.shape[1])
+        if n != self.pages_for(M):
+            raise ValueError(
+                f"{n} page(s) passed for M={M} (need {self.pages_for(M)})"
+            )
+        fn = self._store_fns.get((n, M))
+        if fn is None:
+            fn = jax.jit(
+                lambda pools, idx, mem1, proj1, mask1: _scatter(
+                    pools, idx, mem1, proj1, mask1, self.page_size, n
+                ),
+                donate_argnums=(0,),
+            )
+            self._store_fns[(n, M)] = fn
+        # explicit upload: the serving loop runs under transfer_guard
+        idx = jax.device_put(np.asarray(pages, np.int32))
+        self.mem, self.proj, self.mask = fn(
+            (self.mem, self.proj, self.mask), idx, memory, proj, mask
+        )
+
+
+def _scatter(pools, idx, memory, proj, mask, page_size: int, n: int):
+    mem_pool, proj_pool, mask_pool = pools
+    M = memory.shape[1]
+    pad = n * page_size - M
+    memp = jnp.pad(memory[0], ((0, pad), (0, 0)))
+    projp = jnp.pad(proj[0], ((0, pad), (0, 0)))
+    maskp = jnp.pad(mask[0].astype(jnp.float32), ((0, pad),))
+    return (
+        mem_pool.at[idx].set(memp.reshape(n, page_size, -1)),
+        proj_pool.at[idx].set(projp.reshape(n, page_size, -1)),
+        mask_pool.at[idx].set(maskp.reshape(n, page_size)),
+    )

@@ -20,12 +20,14 @@ cd "$(dirname "$0")/.."
 python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_decode.py bench_recipe.py \
+    bench_serving.py \
     --check-stale --timings --budget 2
 
 # catches syntax errors in files graftlint may not reach (non-.py-suffixed
 # entry points aside, this is the whole tree)
 python -m compileall -q cst_captioning_tpu tests scripts \
-    bench.py bench_attention.py bench_decode.py bench_recipe.py
+    bench.py bench_attention.py bench_decode.py bench_recipe.py \
+    bench_serving.py
 
 # obs_report smoke check: the report CLI must aggregate a known-good run dir
 # without a jax import or backend init (it is part of the operator loop for
@@ -37,6 +39,11 @@ python -m cst_captioning_tpu.cli.obs_report tests/fixtures/obs_run > /dev/null
 # bit-exactness gate inside — keeps bench_decode.py and the kernel from
 # rotting without a TPU in CI (README "Decode fast path")
 JAX_PLATFORMS=cpu python bench_decode.py --smoke > /dev/null
+
+# serving smoke: tiny seeded Poisson+bursty traces through the continuous
+# engine AND the static-batching reference — asserts goodput > 0 and the
+# served-vs-offline bit-parity block (README "Serving")
+JAX_PLATFORMS=cpu python bench_serving.py --smoke > /dev/null
 
 # runtime sanitizer smoke: the hot-path tier-1 subset under
 # jax.transfer_guard("disallow") + jax.debug_nans — the empirical half of

@@ -235,3 +235,57 @@ def test_two_process_cluster_matches_single_process(synth, tmp_path):
     np.testing.assert_allclose(
         multi["leaf_sums"], single["leaf_sums"], rtol=1e-4, atol=1e-5
     )
+
+
+# ---- 4. partial kill: one REAL process dies, the survivor drains ------------
+
+
+def test_partial_kill_survivor_drains(synth, tmp_path):
+    """The PR 6 elastic path on REAL processes, not sim-hosts: a 2-process
+    jax.distributed cluster shares one heartbeat dir; process 1 hard-dies
+    mid-epoch (seeded chaos kill -> os._exit), and process 0's
+    HealthMonitor must declare the loss from heartbeat staleness, drain
+    (peer-loss checkpoint), and raise PeerLost (strict elastic). Trainers
+    are per-process (no cross-process computations — this CPU backend
+    cannot run them; the elastic machinery under test is entirely
+    file-and-signal based and identical on a TPU pod)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    out_json = str(tmp_path / "pk.json")
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "tests", "_multihost_child.py"),
+             str(i), "2", str(port), synth, out_json, str(tmp_path),
+             "partial_kill"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    errs = []
+    for i, p in enumerate(procs):
+        _, err = p.communicate(timeout=300)
+        errs.append(err)
+    reports = {}
+    for i in range(2):
+        path = f"{out_json}.proc{i}"
+        if os.path.exists(path):
+            reports[i] = json.load(open(path))
+    if not reports or not all(r.get("initialized") for r in reports.values()):
+        pytest.skip(
+            "2-process jax.distributed cluster unavailable here: "
+            f"{reports or errs}"
+        )
+    victim, survivor = reports[1], reports[0]
+    assert victim.get("died") == "SimulatedKill", victim
+    assert survivor.get("peer_lost") == [1], (survivor, errs[0][-2000:])
+    # the drain saved a mid-epoch step checkpoint before PeerLost unwound
+    assert survivor.get("drained_ckpts"), survivor

@@ -835,3 +835,87 @@ def test_report_no_health_section_without_signals(tmp_path):
     rep = report_run(run)
     assert rep["health"] is None
     assert "health:" not in render_report(rep)
+
+
+# ---- XLA HLO cost-analysis backend (obs/flops.compiled_cost) ----------------
+
+
+def test_compiled_cost_reports_hlo_flops():
+    """The compiled-program FLOPs backend: a known matmul's HLO cost is
+    exactly 2*m*n*k, and jitted callables are accepted as-is."""
+    import jax
+    import numpy as np
+
+    from cst_captioning_tpu.obs.flops import compiled_cost
+
+    a = np.ones((32, 48), np.float32)
+    b = np.ones((48, 16), np.float32)
+    cost = compiled_cost(lambda x, y: x @ y, a, b)
+    assert cost is not None
+    assert cost["flops"] == 2 * 32 * 48 * 16
+    assert cost["bytes_accessed"] > 0
+    jitted = jax.jit(lambda x, y: x @ y)
+    cost2 = compiled_cost(jitted, a, b)
+    assert cost2 is not None and cost2["flops"] == cost["flops"]
+
+
+def test_compiled_cost_degrades_to_none():
+    """Analysis failures degrade to None (the analytic-model fallback), by
+    contract — never to a crash."""
+    from cst_captioning_tpu.obs.flops import compiled_cost
+
+    # not traceable -> lower() raises inside -> None
+    assert compiled_cost(lambda: open("/nonexistent")) is None
+
+
+def test_report_serving_section_from_synthetic_events(tmp_path):
+    """The serving section aggregates the engine's funnel counters + the
+    per-request phase histograms (queue-wait / encode / decode / detok)."""
+    import os
+
+    from cst_captioning_tpu.obs.report import render_report, report_run
+
+    run = str(tmp_path / "run")
+    hist = {}
+    for name, p50 in (("queue_wait", 0.01), ("encode", 0.02),
+                      ("decode", 0.3), ("detok", 0.001), ("latency", 0.35)):
+        hist[f"serving.{name}_seconds"] = {
+            "buckets": [0.001, 0.01, 0.1, 1.0],
+            "counts": [0, 0, 5, 0], "sum": 5 * p50, "count": 5, "max": p50,
+        }
+    _write_stream(
+        os.path.join(run, "events.jsonl"),
+        _proc_events(0.0, 2.0, 0.5,
+                     counters={"serving.requests_submitted": 6,
+                               "serving.requests_admitted": 5,
+                               "serving.requests_completed": 5,
+                               "serving.strides": 9,
+                               "serving.drains": 1,
+                               "serving.admission_blocked_pages": 2},
+                     gauges={"serving.pages_in_use": 3.0},
+                     histograms=hist),
+    )
+    rep = report_run(run)
+    sv = rep["serving"]
+    assert sv["submitted"] == 6 and sv["completed"] == 5
+    assert sv["strides"] == 9 and sv["drains"] == 1
+    assert sv["admission_blocked_pages"] == 2
+    assert set(sv["phases"]) == {"queue_wait", "encode", "decode", "detok"}
+    assert sv["phases"]["decode"]["count"] == 5
+    assert sv["latency_p95_s"] > 0
+    text = render_report(rep)
+    assert "serving: 6 submitted, 5 admitted, 5 completed" in text
+    assert "queue_wait" in text and "page backpressure" in text
+
+
+def test_report_no_serving_section_without_requests(tmp_path):
+    import os
+
+    from cst_captioning_tpu.obs.report import render_report, report_run
+
+    run = str(tmp_path / "run")
+    _write_stream(os.path.join(run, "events.jsonl"),
+                  _proc_events(0.0, 1.0, 0.5))
+    rep = report_run(run)
+    assert rep["serving"] is None
+    assert "serving:" not in render_report(rep)

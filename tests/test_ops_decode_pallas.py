@@ -438,3 +438,67 @@ def test_stride_kernel_under_sharded_decode():
     )
     np.testing.assert_array_equal(np.asarray(g2), np.asarray(g1))
     assert s2.shape == s1.shape
+
+
+def test_stride_kernel_per_row_mem_lens():
+    """Per-row raggedness (the serving paged-bank contract): passing
+    ``mem_lens`` must equal decoding against a bank whose mask is zeroed
+    past each row's length — a row's excluded tail leaves the softmax with
+    an exact-zero weight either way, so tokens AND logprobs are
+    bit-identical, not merely close. Also pins the composite oracle."""
+    from cst_captioning_tpu.decoding.common import (
+        gumbel_step_noise, rollout_step_keys,
+    )
+    from cst_captioning_tpu.ops.decode_pallas import (
+        _reference_stride, fused_decode_stride,
+    )
+
+    dims = DIMS["small"]
+    model, params, enc, carry, token = _setup(dims, "float32")
+    cell = params["params"]["cell"]
+    G, B = token.shape
+    M = enc.memory.shape[1]
+    S, V = 3, dims["V"]
+    rng = np.random.default_rng(5)
+    # adversarial raggedness: 1-slot and full-length rows interleaved
+    lens = np.asarray([1, M, 2, M, 1][:B], np.int32)
+    noise = jax.vmap(
+        lambda ks: gumbel_step_noise(ks, (B, V), jnp.float32)
+    )(rollout_step_keys(jax.random.key(6), G - 1, S))
+    finished = jnp.zeros((G, B), bool)
+    # the bank every offline caller would build: mask 0 past each length
+    # (values scrambled past the length to prove they are unobservable)
+    col = np.arange(M)[None, :]
+    mask_cut = jnp.asarray(
+        np.asarray(enc.memory_mask) * (col < lens[:, None])
+    )
+    scramble = jnp.asarray(
+        np.where((col < lens[:, None])[..., None], np.asarray(enc.memory),
+                 rng.normal(size=enc.memory.shape)), enc.memory.dtype
+    )
+    args = (cell, carry, token, finished)
+    kw = dict(noise=noise, t0=jnp.int32(0), steps=S,
+              block_b=dims["block_b"], block_v=dims["block_v"])
+    c_l, tok_l, lp_l = fused_decode_stride(
+        *args, scramble, enc.memory_proj, mask_cut, mem_lens=jnp.asarray(lens),
+        **kw,
+    )
+    c_m, tok_m, lp_m = fused_decode_stride(
+        *args, enc.memory * mask_cut[..., None], enc.memory_proj, mask_cut,
+        **kw,
+    )
+    np.testing.assert_array_equal(np.asarray(tok_l), np.asarray(tok_m))
+    np.testing.assert_array_equal(np.asarray(lp_l), np.asarray(lp_m))
+    for a, b in zip(jax.tree.leaves(c_l), jax.tree.leaves(c_m)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # composite oracle honors mem_lens identically (the interpret-mode
+    # shard_map fallback serving relies on)
+    c_r, tok_r, lp_r = _reference_stride(
+        cell, carry, token, finished, scramble, enc.memory_proj, mask_cut,
+        noise, jnp.int32(0), steps=S, temperature=1.0, min_len=0,
+        mem_lens=jnp.asarray(lens),
+    )
+    np.testing.assert_array_equal(np.asarray(tok_r), np.asarray(tok_l))
+    np.testing.assert_allclose(
+        np.asarray(lp_r), np.asarray(lp_l), rtol=2e-5, atol=2e-5
+    )

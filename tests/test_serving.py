@@ -1,0 +1,425 @@
+"""Serving subsystem: continuous batching, paged bank, drain, parity.
+
+The load-bearing pin is ACCEPTANCE PARITY: a request admitted mid-flight
+into the continuous-batching engine must emit token- AND logprob-bit-
+identical output to the same clip decoded offline through
+``decoding.fused.fused_decode`` — the admission/compaction seam must not
+perturb RNG streams or attention reads. Everything else (pages, traffic,
+drain/restore, NPAD selection, obs) hangs off the same tiny model.
+"""
+
+import dataclasses
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config.config import EOS_ID, PAD_ID, ModelConfig
+from cst_captioning_tpu.decoding.fused import fused_decode
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.resilience.chaos import Fault, FaultPlan
+from cst_captioning_tpu.serving import (
+    CaptionService,
+    ClipRequest,
+    OutOfPages,
+    PageBank,
+    Trace,
+    TrafficSpec,
+    load_snapshot,
+    make_trace,
+    static_batch_serve,
+)
+from cst_captioning_tpu.serving.traffic import synth_request_features
+
+MODAL = (("resnet", 16),)
+T = 12
+MAX_F = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab_size=97, modalities=MODAL, d_embed=16, d_hidden=16, d_att=8,
+        encoder="temporal_attention", dropout=0.0, max_len=T,
+        max_frames=MAX_F, dtype="float32",
+    )
+    model = CaptionModel(cfg)
+    rng = np.random.default_rng(0)
+    feats0 = {"resnet": jnp.asarray(rng.normal(size=(1, MAX_F, 16)),
+                                    jnp.float32)}
+    masks0 = {"resnet": jnp.ones((1, MAX_F), jnp.float32)}
+    params = model.init(
+        jax.random.key(0), feats0, masks0, jnp.zeros((1, T), jnp.int32)
+    )
+    # EOS-biased so caption lengths vary (the continuous-batching regime);
+    # shared by every path, so parity comparisons are unaffected
+    bias = params["params"]["cell"]["out_proj"]["bias"]
+    params["params"]["cell"]["out_proj"]["bias"] = bias.at[EOS_ID].add(2.0)
+    return model, params
+
+
+def _requests(frames=(1, 8, 3, 8, 2, 5), seed0=1000):
+    out = []
+    for i, F in enumerate(frames):
+        rng = np.random.default_rng(100 + i)
+        out.append(ClipRequest(
+            req_id=f"r{i}",
+            feats={"resnet": rng.normal(size=(F, 16)).astype(np.float32)},
+            masks={"resnet": np.ones((F,), np.float32)},
+            seed=seed0 + i,
+        ))
+    return out
+
+
+def _offline(model, params, req, K=2, min_len=0):
+    """The parity oracle: the clip decoded alone through fused.py, padded
+    to max_frames like every offline caller pads."""
+    pad = model.cfg.max_frames - req.num_frames
+    f1 = {"resnet": jnp.asarray(
+        np.pad(np.asarray(req.feats["resnet"], np.float32),
+               ((0, pad), (0, 0)))[None]
+    )}
+    m1 = {"resnet": jnp.asarray(
+        np.pad(np.asarray(req.masks["resnet"], np.float32), ((0, pad),))[None]
+    )}
+    g, gl, s, sl = jax.tree.map(np.asarray, fused_decode(
+        model, params, f1, m1, jax.random.key(req.seed), num_rollouts=K,
+        min_len=min_len,
+    ))
+    return (np.concatenate([g, s[:, 0]], axis=0),
+            np.concatenate([gl, sl[:, 0]], axis=0))
+
+
+def _assert_parity(model, params, report, reqs, K=2, min_len=0):
+    for req in reqs:
+        tok, lp = _offline(model, params, req, K=K, min_len=min_len)
+        res = report.results[req.req_id]
+        np.testing.assert_array_equal(res.tokens, tok, err_msg=req.req_id)
+        np.testing.assert_array_equal(res.logprobs, lp, err_msg=req.req_id)
+
+
+# ---- traffic ----------------------------------------------------------------
+
+
+def test_trace_is_deterministic_and_replayable(tmp_path):
+    spec = TrafficSpec(kind="poisson", rate_rps=5.0, num_requests=16,
+                       seed=3, frame_choices=(1, 4, 8))
+    a, b = make_trace(spec), make_trace(spec)
+    assert a.items == b.items and len(a) == 16
+    assert all(
+        x.arrival_s <= y.arrival_s for x, y in zip(a.items, a.items[1:])
+    )
+    path = str(tmp_path / "trace.json")
+    a.save(path)
+    assert Trace.load(path).items == a.items
+    # feature payloads regenerate bit-identically from the item seed
+    f1, m1 = synth_request_features(a.items[0], MODAL)
+    f2, _ = synth_request_features(a.items[0], MODAL)
+    np.testing.assert_array_equal(f1["resnet"], f2["resnet"])
+    assert m1["resnet"].shape == (a.items[0].num_frames,)
+
+
+def test_bursty_trace_modulates_rate():
+    spec = TrafficSpec(kind="bursty", rate_rps=10.0, num_requests=64,
+                       seed=1, burst_factor=8.0, burst_len_s=1.0)
+    t = make_trace(spec)
+    # burst windows (even seconds) hold far more arrivals than quiet ones
+    burst = sum(1 for i in t.items if int(i.arrival_s) % 2 == 0)
+    assert burst > len(t) * 0.7
+
+
+def test_traffic_spec_validation():
+    with pytest.raises(ValueError, match="kind"):
+        TrafficSpec(kind="steady")
+    with pytest.raises(ValueError, match="rate"):
+        TrafficSpec(rate_rps=0.0)
+    with pytest.raises(ValueError, match="burst"):
+        TrafficSpec(kind="bursty", burst_factor=0.5)
+
+
+# ---- page bank --------------------------------------------------------------
+
+
+def test_page_bank_alloc_free_accounting():
+    bank = PageBank(num_pages=6, page_size=4)
+    p1 = bank.alloc("a", 9)     # ceil(9/4) = 3 pages
+    assert len(p1) == 3 and bank.pages_in_use == 3
+    assert 0 not in p1          # page 0 is the reserved zero page
+    p2 = bank.alloc("b", 4)
+    assert len(p2) == 1 and bank.pages_in_use == 4
+    with pytest.raises(OutOfPages):
+        bank.alloc("c", 12)     # 3 pages needed, 2 free
+    with pytest.raises(ValueError, match="already holds"):
+        bank.alloc("a", 4)
+    table = bank.table(["a", "b", None], width=3)
+    assert table.shape == (3, 3)
+    np.testing.assert_array_equal(table[0], p1)
+    assert table[1, 0] == p2[0] and (table[1, 1:] == 0).all()
+    assert (table[2] == 0).all()
+    bank.free("a")
+    assert bank.pages_in_use == 1 and bank.free_pages == 5
+    assert bank.alloc("c", 12) and bank.pages_in_use == 4
+    snap = bank.snapshot()
+    assert snap["page_size"] == 4 and set(snap["owned"]) == {"b", "c"}
+
+
+# ---- the acceptance pin: mid-flight admission parity ------------------------
+
+
+def test_midflight_admission_is_bit_identical_to_offline(setup):
+    """capacity 2 << 6 ragged requests: most requests are admitted into
+    lanes freed mid-flight while other requests sit at arbitrary local
+    steps. Token AND logprob parity with the offline B=1 fused decode pins
+    that the admission/compaction seam perturbs nothing."""
+    model, params = setup
+    reqs = _requests()
+    svc = CaptionService(model, params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    report = svc.serve(reqs)
+    assert report.completed == len(reqs) and not report.drained
+    # continuous batching actually happened: more strides than one batch
+    # of 2 would need, and every slot was reused
+    assert report.strides > (T // 4)
+    _assert_parity(model, params, report, reqs)
+    # all pages and slots returned
+    assert svc.bank.pages_in_use == 0 and len(svc._free_slots) == 2
+
+
+def test_serving_parity_with_min_len(setup):
+    """min_len rides per-ROW in the serving step (each request's own local
+    t), matching offline ``apply_min_len`` bit-for-bit."""
+    model, params = setup
+    reqs = _requests(frames=(2, 8, 5))
+    report = CaptionService(
+        model, params, capacity=2, num_rollouts=2, stride=4, min_len=3,
+    ).serve(reqs)
+    for res in report.results.values():
+        lens = (res.tokens != PAD_ID).sum(axis=1)
+        assert (lens >= 3).all()
+    _assert_parity(model, params, report, reqs, min_len=3)
+
+
+def test_serving_pallas_kernel_parity(setup):
+    """The stride-kernel path (per-row mem_lens raggedness, in-kernel
+    selection, kernel_block_b=1) is bit-identical to the same clips decoded
+    offline through the pallas stride path."""
+    model, params = setup
+    m_pal = CaptionModel(dataclasses.replace(
+        model.cfg, decode_impl="pallas", decode_stride=4,
+    ))
+    reqs = _requests()
+    report = CaptionService(
+        m_pal, params, capacity=2, num_rollouts=2, stride=4, frame_bucket=2,
+    ).serve(reqs)
+    _assert_parity(m_pal, params, report, reqs)
+
+
+def test_page_table_stress_adversarial_ragged(setup):
+    """1-frame and max-frame clips interleaved through a pool deliberately
+    too small to hold the working set: admission backpressures on pages,
+    every request still completes with bit-exact output, and the bank
+    drains back to empty."""
+    model, params = setup
+    frames = [1, 8, 1, 8, 1, 8, 1, 8, 1, 8]
+    reqs = _requests(frames=frames, seed0=7000)
+    svc = CaptionService(
+        model, params, capacity=4, num_rollouts=1, stride=4, frame_bucket=1,
+        page_size=2, num_pages=6,  # 12 slots: < 2 max-frame clips' worth
+    )
+    report = svc.serve(reqs)
+    assert report.completed == len(reqs)
+    _assert_parity(model, params, report, reqs, K=1)
+    assert svc.bank.pages_in_use == 0
+    assert svc.bank.pages_hwm <= 6
+
+
+def test_single_request_larger_than_pool_raises(setup):
+    model, params = setup
+    svc = CaptionService(model, params, capacity=2, num_rollouts=1,
+                         page_size=2, num_pages=2)
+    with pytest.raises(OutOfPages, match="more pages than the whole pool"):
+        svc.serve(_requests(frames=(8,)))
+
+
+def test_npad_best_lane_selection(setup):
+    """NPAD anytime-quality: the served caption is the best-scoring lane,
+    so its total logprob is >= the greedy lane's by construction, and the
+    caption ids are that lane's tokens up to EOS."""
+    model, params = setup
+    reqs = _requests(frames=(4, 8, 6), seed0=4000)
+    report = CaptionService(
+        model, params, capacity=3, num_rollouts=3, temperature=1.3,
+    ).serve(reqs)
+    for res in report.results.values():
+        sums = res.logprobs.sum(axis=1)
+        assert sums[res.best_lane] == sums.max()
+        assert sums[res.best_lane] >= sums[0]
+        row = res.tokens[res.best_lane]
+        expect = []
+        for tok in row:
+            if tok in (EOS_ID, PAD_ID):
+                break
+            expect.append(int(tok))
+        assert res.caption_ids == expect
+        assert set(res.phases) == {"queue_wait", "encode", "decode", "detok"}
+
+
+def test_batched_admission_encode_group_parity(setup):
+    """admit_group > 1 batches same-bucket admission encodes into one pass;
+    at f32 the encoder gemm is row-stable, so parity must hold bit-for-bit
+    (the knob's contract — bf16-on-CPU is documented out)."""
+    model, params = setup
+    reqs = _requests(frames=(8, 8, 8, 8), seed0=5000)
+    report = CaptionService(
+        model, params, capacity=4, num_rollouts=2, admit_group=4,
+    ).serve(reqs)
+    _assert_parity(model, params, report, reqs)
+
+
+# ---- drain / snapshot / recovery --------------------------------------------
+
+
+def test_serving_preempt_chaos_drains_and_recovers_bit_identical(
+    setup, tmp_path
+):
+    """The seeded ``serving_preempt`` fault drains the loop mid-flight:
+    in-flight strides finish, admissions stop, queue + page table persist.
+    Replaying the drained queue through a fresh service completes the
+    remaining requests BIT-identically to the undrained run."""
+    model, params = setup
+    reqs = _requests()
+    base = CaptionService(model, params, capacity=2, num_rollouts=2,
+                          stride=4, frame_bucket=2).serve(reqs)
+
+    snap = str(tmp_path / "drain")
+    plan = FaultPlan([Fault("serving.step", "serving_preempt", at=3)])
+    svc = CaptionService(model, params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    with plan.activate():
+        drained = svc.serve(_requests(), snapshot_dir=snap)
+    assert plan.fired and plan.fired[0]["kind"] == "serving_preempt"
+    assert drained.drained and drained.drain_reason == "chaos_serving_preempt"
+    assert drained.completed < len(reqs)
+    assert os.path.exists(os.path.join(snap, "manifest.json"))
+    assert os.path.exists(os.path.join(snap, "queue.npz"))
+
+    restored = load_snapshot(snap)
+    assert len(restored) == len(reqs) - drained.completed
+    replay = CaptionService(model, params, capacity=2, num_rollouts=2,
+                            stride=4, frame_bucket=2).serve(restored)
+    union = dict(drained.results)
+    union.update(replay.results)
+    assert set(union) == set(base.results)
+    for rid, res in base.results.items():
+        np.testing.assert_array_equal(union[rid].tokens, res.tokens, rid)
+        np.testing.assert_array_equal(union[rid].logprobs, res.logprobs, rid)
+
+
+def test_snapshot_records_page_table_and_order(setup, tmp_path):
+    model, params = setup
+    import json
+
+    snap = str(tmp_path / "drain2")
+    plan = FaultPlan([Fault("serving.step", "serving_preempt", at=2)])
+    svc = CaptionService(model, params, capacity=2, num_rollouts=1,
+                         stride=4, frame_bucket=2)
+    with plan.activate():
+        svc.serve(_requests(), snapshot_dir=snap)
+    manifest = json.load(open(os.path.join(snap, "manifest.json")))
+    assert manifest["drain_reason"] == "chaos_serving_preempt"
+    pt = manifest["page_table"]
+    assert pt["num_pages"] == svc.bank.num_pages
+    # stride-boundary drain: requests were genuinely IN FLIGHT at the cut
+    assert manifest["in_flight_steps"]
+    # in-flight requests lead the persisted order (admitted earlier)
+    inflight = set(manifest["in_flight_steps"])
+    lead = [r["req_id"] for r in manifest["requests"][:len(inflight)]]
+    assert set(lead) == inflight
+
+
+def test_sigterm_drains_the_loop(setup, tmp_path):
+    """A real SIGTERM mid-serve stops at the next stride boundary via the
+    PreemptionHandler path (drain_reason='sigterm')."""
+    model, params = setup
+    import signal
+
+    snap = str(tmp_path / "sig")
+    svc = CaptionService(model, params, capacity=1, num_rollouts=1, stride=4)
+    # many requests through one lane: plenty of stride boundaries
+    reqs = _requests(frames=(8,) * 6, seed0=6000)
+    timer = threading.Timer(
+        0.05, lambda: os.kill(os.getpid(), signal.SIGTERM)
+    )
+    timer.start()
+    try:
+        report = svc.serve(reqs, snapshot_dir=snap)
+    finally:
+        timer.cancel()
+    assert report.drained and report.drain_reason == "sigterm"
+    assert report.completed < len(reqs)
+    assert len(load_snapshot(snap)) == len(reqs) - report.completed
+
+
+def test_static_batch_serve_completes_all(setup):
+    model, params = setup
+    reqs = _requests()
+    report = static_batch_serve(model, params, reqs, capacity=2,
+                                num_rollouts=2)
+    assert report.completed == len(reqs)
+    for res in report.results.values():
+        assert res.tokens.shape == (3, T)
+
+
+# ---- zero-sync discipline ---------------------------------------------------
+
+
+def test_serving_loop_is_transfer_guard_clean(setup):
+    """The warmed admission/decode loop holds under
+    ``jax.transfer_guard("disallow")``: every host<->device crossing in the
+    serving loop is explicit (device_put up, one device_get down per
+    stride) — the empirical half of the GL001-clean claim."""
+    model, params = setup
+    svc = CaptionService(model, params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    svc.serve(_requests(frames=(2, 8)))          # warm: compiles stage eagerly
+    with jax.transfer_guard("disallow"):
+        report = svc.serve(_requests(frames=(1, 8, 3), seed0=9000))
+    assert report.completed == 3
+
+
+# ---- obs --------------------------------------------------------------------
+
+
+def test_serving_obs_events_and_report(setup, tmp_path):
+    """A served run under obs leaves per-request phase histograms and
+    span/request events that cli.obs_report aggregates into the serving
+    section."""
+    from cst_captioning_tpu import obs
+    from cst_captioning_tpu.obs import metrics as obs_metrics
+    from cst_captioning_tpu.obs.report import report_run, render_report
+
+    model, params = setup
+    obs_metrics.REGISTRY.reset()
+    run_dir = str(tmp_path / "obsrun")
+    obs.configure(run_dir, run="serve-test")
+    try:
+        CaptionService(model, params, capacity=2, num_rollouts=1,
+                       stride=4).serve(_requests(frames=(2, 8, 5)))
+        obs.snapshot_metrics()
+    finally:
+        obs.shutdown()
+    rep = report_run(run_dir)
+    sv = rep["serving"]
+    assert sv is not None
+    assert sv["submitted"] == 3 and sv["completed"] == 3
+    assert sv["strides"] >= 1
+    assert sv["phases"]["decode"]["count"] == 3
+    assert sv["phases"]["queue_wait"]["count"] == 3
+    text = render_report(rep)
+    assert "serving: 3 submitted" in text
+    # engine-loop spans land in the phase table
+    names = {p["phase"] for p in rep["phases"]}
+    assert {"serving.stride", "serving.encode"} <= names
