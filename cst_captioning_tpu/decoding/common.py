@@ -140,13 +140,21 @@ def scan_until_finished(step, init, length: int, get_finished, y_fills,
     that tail while keeping every shape static.
 
     Bit-exactness contract (the caller's to uphold): once
-    ``get_finished(state)`` is all-True, ``step`` must be an identity on the
-    state and emit exactly ``y_fills`` — true for the EOS-frozen decode loops
-    here (PAD token / 0.0 logprob emission; the beam step degenerates to the
+    ``get_finished(state)`` is all-True, ``step`` must be an identity on
+    the OUTPUT-RELEVANT state components (whatever ``get_finished`` and
+    the emitted ys read — finished flags, tokens, beam bookkeeping) and
+    emit exactly ``y_fills`` — true for the EOS-frozen decode loops here
+    (PAD token / 0.0 logprob emission; the beam step degenerates to the
     identity permutation, see beam.py). Under that contract the early exit
-    returns bit-identical arrays to the full scan: the y-buffers are
+    returns ``ys`` bit-identical to the full scan: the y-buffers are
     pre-filled with the post-finish emission, and any overhang step past
     ``length`` (non-divisor stride only) is select-frozen out of the state.
+
+    The returned ``final_state`` is NOT covered by that guarantee: the
+    decode steps keep evolving their LSTM carries on post-finish steps, so
+    under early exit the carry differs from the full scan's (every caller
+    here discards it). A future caller wanting the final carry must either
+    freeze it in ``step`` once finished or decode without early exit.
 
     ``batch_axes`` names the mesh axes the batch dim is sharded over (when
     called inside ``shard_map``). The unfinished-row count is psum'd over

@@ -132,7 +132,16 @@ class NativeCiderD:
         """(corpus mean, per-id array) in res-key order — the Python
         ``CiderD.compute_score`` contract. None when ``res`` ids don't match
         the prepared pool (df="corpus" semantics depend on the id set; the
-        caller falls back to the Python oracle)."""
+        caller falls back to the Python oracle).
+
+        Precision contract: the kernel computes per-id scores in double
+        but returns them through a float32 ABI (``creward.cpp``'s
+        ``out[i] = (float)r``), so results differ from the float64 Python
+        oracle by up to ~1e-7 relative (~1e-8 typical). Consumers
+        comparing native and fallback paths — best-checkpoint selection
+        ties included — must treat scores within that band as equal; the
+        band is pinned by the parity tests in tests/test_metrics_cider.py.
+        """
         ids = list(res.keys())
         if set(ids) != set(self._video_index):
             return None

@@ -360,6 +360,11 @@ void crw_score(void* h, const int32_t* video_idx, const int32_t* rows,
                 r += cider_w * cider_d_one(*c, vs, hyp, hnorm, len);
             if (bleu_w != 0.0)
                 r += bleu_w * bleu4_one(*c, vs, hyp, len) * 10.0;
+            // scores are computed in double but cross the ABI as float32:
+            // callers comparing against a float64 oracle (the Python
+            // CiderD scorer) must budget ~1e-7 relative tolerance for this
+            // narrowing — pinned by the parity tests in
+            // tests/test_metrics_cider.py
             out[i] = (float)r;
         }
     };

@@ -3,13 +3,16 @@
 Usage: ``python -m cst_captioning_tpu.tools.graftlint [paths]`` — see
 :mod:`cst_captioning_tpu.tools.graftlint.cli` for flags, ``--list-rules``
 for the rule table, and the README "Static analysis" section for rationale,
-suppression syntax (``# graftlint: disable=GL00X``), and baseline workflow.
+suppression syntax (``# graftlint: disable=GL00X``), baseline workflow,
+and the ``--fix`` / ``--fix-check`` autofix modes (:mod:`fixes`).
 """
 
 from cst_captioning_tpu.tools.graftlint.core import (
     Baseline,
+    Edit,
     FileContext,
     Finding,
+    Fix,
     LintResult,
     ProjectRule,
     Rule,
@@ -22,8 +25,10 @@ from cst_captioning_tpu.tools.graftlint.project import ProjectIndex
 
 __all__ = [
     "Baseline",
+    "Edit",
     "FileContext",
     "Finding",
+    "Fix",
     "LintResult",
     "ProjectIndex",
     "ProjectRule",

@@ -4,15 +4,27 @@
         [--baseline PATH | --no-baseline] [--write-baseline]
         [--rules GL001,GL002] [--root DIR] [--list-rules]
         [--check-stale] [--timings] [--budget SECONDS] [--no-cache]
+        [--fix [--dry-run]] [--fix-check]
 
 Exit codes: 0 = no new error/warning findings (info and baselined findings
 never gate), 1 = new findings / stale baseline or suppressions with
---check-stale / budget exceeded with --budget, 2 = usage error.
+--check-stale / budget exceeded with --budget / unfixed autofixable
+findings with --fix-check / fixes skipped or surviving with --fix,
+2 = usage error.
 
 ``--check-stale`` additionally fails the run when a ``graftlint.baseline``
 entry no longer fires or an inline ``# graftlint: disable=GLxxx`` suppresses
 nothing — dead grandfathers silently re-open the door for a finding to come
-back. The runtime counterpart of the static GL001/GL013 transfer claims is
+back.
+
+``--fix`` applies the mechanical repairs rules attach to findings (see
+:mod:`fixes`) plus stale-suppression/baseline removal, re-parses every
+rewritten file, then RE-LINTS and fails unless the tree is fix-clean —
+so applying ``--fix`` twice is always a no-op. ``--fix --dry-run`` prints
+the unified diff without writing. ``--fix-check`` is the CI spelling: it
+fails while any autofixable finding is unfixed, touching nothing.
+
+The runtime counterpart of the static GL001/GL013 transfer claims is
 ``scripts/sanitize.sh``, which runs a tier-1 subset under
 ``pytest --sanitize`` (``jax.transfer_guard("disallow")`` + debug_nans).
 """
@@ -74,6 +86,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--no-cache", action="store_true",
                     help="skip the on-disk project-summary cache "
                          "(<root>/.graftlint_cache.json)")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply the mechanical fixes rules attach to NEW "
+                         "findings (plus stale suppression/baseline "
+                         "removal), re-parse, re-lint, and fail unless "
+                         "the tree ends fix-clean")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="with --fix: print the unified diff instead of "
+                         "writing files")
+    ap.add_argument("--fix-check", action="store_true",
+                    help="CI mode: fail (exit 1) while any autofixable "
+                         "finding is unfixed; never writes")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -107,6 +130,14 @@ def main(argv: list[str] | None = None) -> int:
         print("graftlint: --check-stale needs the full rule set and a "
               "baseline (drop --rules / --no-baseline)", file=sys.stderr)
         return 2
+    if args.fix and args.fix_check:
+        print("graftlint: --fix and --fix-check are exclusive (apply or "
+              "gate, not both)", file=sys.stderr)
+        return 2
+    if args.dry_run and not args.fix:
+        print("graftlint: --dry-run only means something with --fix",
+              file=sys.stderr)
+        return 2
     try:
         result = lint_paths(
             paths, root, baseline=baseline, rule_ids=rule_ids,
@@ -115,6 +146,15 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
+
+    fix_failed = False
+    if args.fix:
+        result, rc = _run_fix(
+            args, paths, root, rule_ids, baseline_path, result
+        )
+        if args.dry_run:
+            return rc
+        fix_failed = rc != 0
 
     total_seconds = result.index_seconds + result.rules_seconds
     if args.timings:
@@ -153,7 +193,15 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
 
-    failed = bool(result.gating)
+    failed = bool(result.gating) or fix_failed
+    if args.fix_check:
+        for f in result.fixable:
+            print(
+                f"graftlint: autofixable: {f.path}:{f.line} {f.rule} — "
+                f"{f.fix.description}; run `--fix` to apply",
+                file=sys.stderr,
+            )
+            failed = True
     if args.check_stale:
         for e in result.stale_baseline:
             print(
@@ -181,6 +229,86 @@ def main(argv: list[str] | None = None) -> int:
         )
         failed = True
     return 1 if failed else 0
+
+
+_FIX_MAX_ROUNDS = 5
+
+
+def _run_fix(args, paths, root, rule_ids, baseline_path, result):
+    """Apply fixes until the tree is fix-clean (or no progress), re-linting
+    after every write — the idempotence proof. Returns the post-fix lint
+    result and an exit code (0 = converged clean)."""
+    from cst_captioning_tpu.tools.graftlint.fixes import (
+        plan_fixes,
+        write_plan,
+    )
+
+    baseline = None if args.no_baseline else Baseline.load(baseline_path)
+    if args.dry_run:
+        plan = plan_fixes(result, root, baseline=baseline)
+        for file_fix in plan.files:
+            print(file_fix.diff(), end="")
+        _print_fix_summary(plan, dry=True)
+        return result, 0
+
+    rounds = 0
+    while result.fixable or result.unused_suppressions or \
+            result.stale_baseline:
+        if rounds >= _FIX_MAX_ROUNDS:
+            print(
+                "graftlint: --fix did not converge after "
+                f"{_FIX_MAX_ROUNDS} rounds — a fixer is not idempotent",
+                file=sys.stderr,
+            )
+            return result, 1
+        plan = plan_fixes(result, root, baseline=baseline)
+        if plan.applied_count == 0 and plan.stale_baseline_removed == 0:
+            unfixed = len(result.fixable)
+            if unfixed:
+                print(
+                    f"graftlint: {unfixed} autofixable finding(s) could "
+                    "not be applied (see skips above)",
+                    file=sys.stderr,
+                )
+                _print_fix_summary(plan, dry=False)
+                return result, 1
+            break  # only unused suppressions with no comment found: done
+        write_plan(plan)
+        _print_fix_summary(plan, dry=False)
+        rounds += 1
+        # the idempotence proof: re-lint the same paths from disk
+        baseline = None if args.no_baseline else Baseline.load(
+            baseline_path
+        )
+        result = lint_paths(
+            paths, root, baseline=baseline, rule_ids=rule_ids,
+            cache_path="" if args.no_cache else None,
+        )
+    rc = 0
+    if result.fixable:
+        print(
+            f"graftlint: {len(result.fixable)} autofixable finding(s) "
+            "survived --fix — a fixer regressed",
+            file=sys.stderr,
+        )
+        rc = 1
+    return result, rc
+
+
+def _print_fix_summary(plan, dry: bool) -> None:
+    verb = "would fix" if dry else "fixed"
+    for file_fix in plan.files:
+        for line in file_fix.applied:
+            print(f"graftlint: {verb}: {line}", file=sys.stderr)
+    for _, reason in plan.skipped:
+        if reason:
+            print(f"graftlint: skipped: {reason}", file=sys.stderr)
+    if plan.stale_baseline_removed:
+        print(
+            f"graftlint: {verb}: removed {plan.stale_baseline_removed} "
+            "stale baseline entr(y/ies)",
+            file=sys.stderr,
+        )
 
 
 if __name__ == "__main__":  # pragma: no cover

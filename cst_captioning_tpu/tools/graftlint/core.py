@@ -9,7 +9,9 @@ network and the linter must run wherever the tests run.
 Layers:
 
 - :class:`Finding`        — one diagnostic, with a line-content fingerprint
-  that survives unrelated line-number drift.
+  that survives unrelated line-number drift; may carry a :class:`Fix`.
+- :class:`Fix`            — span-precise source edits repairing a finding
+  mechanically (applied by :mod:`fixes` under ``--fix``).
 - :class:`Rule`           — registry-registered check over a
   :class:`FileContext`; per-rule id / severity / docs.
 - suppressions            — ``# graftlint: disable=GL001[,GL002|all]`` on the
@@ -55,6 +57,47 @@ _SKIP_DIRS = {
 }
 
 
+@dataclass(frozen=True)
+class Edit:
+    """One span-precise replacement: ``[start, end)`` in (1-based line,
+    0-based col) coordinates, the same frame ``ast`` nodes report."""
+
+    line: int
+    col: int
+    end_line: int
+    end_col: int
+    replacement: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_node(cls, node: ast.AST, replacement: str) -> "Edit":
+        return cls(
+            line=node.lineno, col=node.col_offset,
+            end_line=int(node.end_lineno or node.lineno),
+            end_col=int(node.end_col_offset or node.col_offset),
+            replacement=replacement,
+        )
+
+
+@dataclass
+class Fix:
+    """A mechanical repair for one finding: non-overlapping edits plus a
+    one-line human description (printed by ``--fix`` / the JSON report).
+    Rules only emit a Fix when the rewrite is provably behavior-identical
+    (or restores the invariant the finding names) — never a guess."""
+
+    edits: tuple[Edit, ...]
+    description: str
+
+    def to_dict(self) -> dict:
+        return {
+            "description": self.description,
+            "edits": [e.to_dict() for e in self.edits],
+        }
+
+
 @dataclass
 class Finding:
     """One diagnostic. ``context`` (the stripped source line) + rule + path
@@ -68,12 +111,15 @@ class Finding:
     message: str
     context: str
     baselined: bool = False
+    fix: Fix | None = None
 
     def fingerprint(self) -> tuple[str, str, str]:
         return (self.rule, self.path, self.context)
 
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["fix"] = self.fix.to_dict() if self.fix is not None else None
+        return d
 
     def render(self) -> str:
         tag = " (baselined)" if self.baselined else ""
@@ -125,13 +171,34 @@ class FileContext:
             self._cache["all_nodes"] = cached
         return cached
 
+    def nodes_of(self, *types: type) -> list:
+        """All nodes of the given AST types, from a one-time type-bucketed
+        index over :meth:`walk_nodes` — most rules only look at ``Call``
+        or def nodes, and fifteen full-tree isinstance scans per file were
+        the dominant pass-2 cost. Within one type, walk order is kept;
+        multiple types concatenate (callers that need interleaved source
+        order still use :meth:`walk_nodes`)."""
+        by_type = self._cache.get("nodes_by_type")
+        if by_type is None:
+            by_type = {}
+            for node in self.walk_nodes():
+                by_type.setdefault(type(node), []).append(node)
+            self._cache["nodes_by_type"] = by_type
+        if len(types) == 1:
+            return by_type.get(types[0], [])
+        out: list = []
+        for t in types:
+            out.extend(by_type.get(t, ()))
+        return out
+
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
             return self.lines[lineno - 1].strip()
         return ""
 
     def finding(self, rule: "Rule", node: ast.AST, message: str,
-                severity: str | None = None) -> Finding:
+                severity: str | None = None,
+                fix: Fix | None = None) -> Finding:
         line = getattr(node, "lineno", 1)
         col = getattr(node, "col_offset", 0)
         return Finding(
@@ -142,6 +209,7 @@ class FileContext:
             col=col,
             message=message,
             context=self.line_text(line),
+            fix=fix,
         )
 
     def suppressed(self, f: Finding) -> bool:
@@ -375,6 +443,13 @@ class LintResult:
         """New findings that fail the run (info never gates)."""
         return [f for f in self.new if f.severity in ("error", "warning")]
 
+    @property
+    def fixable(self) -> list[Finding]:
+        """NEW findings carrying a mechanical fix (``--fix`` applies them;
+        ``--fix-check`` fails while any exist). Baselined findings are
+        intentional — their fixes are never applied."""
+        return [f for f in self.new if f.fix is not None]
+
     def to_json(self) -> dict:
         counts = {"total": len(self.findings),
                   "new": len(self.new),
@@ -382,12 +457,19 @@ class LintResult:
                   "by_rule": {}}
         for f in self.findings:
             counts["by_rule"][f.rule] = counts["by_rule"].get(f.rule, 0) + 1
+        fixes = {"autofixable": len(self.fixable), "by_rule": {}}
+        for f in self.fixable:
+            fixes["by_rule"][f.rule] = fixes["by_rule"].get(f.rule, 0) + 1
+        # stale suppressions/baseline entries are repaired by --fix too
+        fixes["stale_suppressions"] = len(self.unused_suppressions)
+        fixes["stale_baseline"] = len(self.stale_baseline)
         return {
             "version": 1,
             "tool": "graftlint",
             "files_checked": self.files_checked,
             "counts": counts,
             "findings": [f.to_dict() for f in self.findings],
+            "fixes": fixes,
             "stale_baseline": self.stale_baseline,
             "unused_suppressions": self.unused_suppressions,
             "timings": {

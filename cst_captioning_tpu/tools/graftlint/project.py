@@ -23,10 +23,29 @@ What the index knows:
   run (GL012's old module-level cache is gone: a long-lived test session
   re-scrapes whenever the index is rebuilt, and the on-disk cache below is
   mtime-keyed).
+- **axis environments** — every def (nested ones included, unlike the
+  function table above) is scanned for named-axis *bindings*
+  (``shard_map``/``vmap(axis_name=)``/``pmap(axis_name=)`` applications,
+  with ``axis_names=`` literals when spelled, all declared mesh axes
+  otherwise) and for collective calls with literal axis names; an abstract
+  interpretation over the call graph then computes, per function, the set
+  of axes bound in at least one reachable calling context. GL016 reads the
+  result: a collective over a *declared* mesh axis that no reachable caller
+  binds is out of scope at runtime, something GL012's literal-vs-mesh check
+  cannot know.
+- **donation facts** — which argument positions a function donates when
+  called (``@partial(jax.jit, donate_argnums=literal)``), whether calling
+  it returns a donating callable (the ``make_*_step`` factory pattern), and
+  which of its own params it forwards into a donated position of a
+  donating callee (a *wrapper* whose donation an outer ``jit`` would
+  silently drop) — all propagated through the same fixpoint for GL017.
 - **on-disk summary cache** — ``<root>/.graftlint_cache.json`` keyed by
   ``(mtime, size)`` per file, so repeat ``lint.sh`` runs skip re-parsing
   unchanged modules in pass 1. Summaries are cached PRE-fixpoint; the
   cross-module fixpoint is recomputed every run (it is global and cheap).
+  The schema version gates the whole cache: adding summary fields bumps
+  ``_CACHE_VERSION`` and an old cache file is discarded wholesale (a cold
+  start, never a half-read).
 
 Everything here is stdlib-``ast`` only — no JAX import, no backend init.
 """
@@ -92,6 +111,103 @@ _KEY_CONSUMERS = {
     "permutation", "randint", "bits", "exponential", "laplace",
     "truncated_normal", "dirichlet", "beta", "gamma", "poisson", "shuffle",
 }
+
+# collective -> positional index of its axis-name argument (canonical home;
+# GL012 and the axis-environment scan share it)
+COLLECTIVE_AXIS_POS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "pbroadcast": 1, "pcast": 1, "axis_index": 0,
+}
+COLLECTIVE_AXIS_KWARGS = ("axis_name",)
+
+# call-position names that bind named axes for the function they wrap
+_AXIS_BINDERS = {"shard_map", "vmap", "pmap"}
+
+_DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+
+
+def _literal_str_tuple(node: ast.AST | None) -> tuple[str, ...] | None:
+    """('data', 'seq') for a string constant or tuple/list/set of string
+    constants; None when absent or not fully literal (never guess)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _literal_int_tuple(node: ast.AST | None) -> tuple[int, ...] | None:
+    """(0, 2) for an int constant or tuple/list of int constants; None for
+    anything dynamic — ``(0,) if donate else ()`` stays out of scope."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def donation_of_call(call: ast.Call) -> tuple[int, ...] | None:
+    """Donated argnums of a ``jax.jit``/``pjit`` call node, when literal.
+
+    Returns None when the call is not a jit, carries no donate kwargs, or
+    the donation expression is dynamic. ``donate_argnames`` cannot be
+    resolved without the target's signature — callers that have it resolve
+    names themselves; here only ``donate_argnums`` literals count."""
+    if _last(_dotted(call.func)) not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _literal_int_tuple(kw.value)
+    return None
+
+
+def _decorator_donation(dec: ast.AST,
+                        params: list[str]) -> tuple[int, ...] | None:
+    """Donated argnums declared by a jit decorator, when literal.
+
+    ``@partial(jax.jit, donate_argnums=(0,))`` and the direct-call form;
+    ``donate_argnames`` resolves against ``params`` (the decorated def's
+    own signature is in hand). Dynamic expressions -> None."""
+    if not isinstance(dec, ast.Call):
+        return None
+    d = _dotted(dec.func)
+    is_jit = _last(d) in ("jit", "pjit") or (
+        _last(d) == "partial" and dec.args
+        and _last(_dotted(dec.args[0])) in ("jit", "pjit")
+    )
+    if not is_jit:
+        return None
+    for kw in dec.keywords:
+        if kw.arg == "donate_argnums":
+            return _literal_int_tuple(kw.value)
+        if kw.arg == "donate_argnames":
+            names = _literal_str_tuple(kw.value)
+            if names is None:
+                return None
+            try:
+                return tuple(params.index(n) for n in names)
+            except ValueError:
+                return None
+    return None
 
 
 def module_name_for(relpath: str) -> str:
@@ -187,6 +303,19 @@ class FunctionSummary:
     # callees whose result this function returns (pre-fixpoint pending set)
     returns_calls: list[str] = field(default_factory=list)
     calls: list[CallSite] = field(default_factory=list)
+    # -- donation facts (GL017) --
+    # arg positions THIS function donates when called (a literal
+    # @partial(jax.jit, donate_argnums=...) decoration)
+    donated_argnums: list[int] = field(default_factory=list)
+    # calling this function returns a callable donating these positions
+    # (the jitted-step factory pattern); fixpoint propagates through
+    # factories-of-factories via returns_calls
+    returns_donating: list[int] = field(default_factory=list)
+    # own param positions forwarded into a donated position of a donating
+    # callee — a wrapper whose donation an outer jit() would silently drop;
+    # the human chain for each position lives in forwards_donated_via
+    forwards_donated: list[int] = field(default_factory=list)
+    forwards_donated_via: dict[str, str] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -201,6 +330,52 @@ class FunctionSummary:
 
 
 @dataclass
+class AxisFuncInfo:
+    """Axis-relevant view of ONE def — nested defs included, each its own
+    entry (unlike the function table, which stops at methods): the
+    collectives it calls with literal axis names, its direct callees, and
+    its lexical parent. The index's axis fixpoint runs over these."""
+
+    qualname: str                    # dot-joined path, e.g. "make_step.step"
+    lineno: int
+    parent: str = ""                 # lexical parent qualname ("" = module)
+    # (collective name, literal axis, lineno, col)
+    collectives: list = field(default_factory=list)
+    # resolved dotted callee names called directly in this def's body
+    calls: list = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AxisFuncInfo":
+        d = dict(d)
+        d["collectives"] = [tuple(c) for c in d.get("collectives", [])]
+        return cls(**d)
+
+
+@dataclass
+class AxisBinding:
+    """One named-axis binding application: ``shard_map(target, ...)`` /
+    ``vmap(target, axis_name=...)`` / ``pmap(target, axis_name=...)``.
+    ``axes is None`` means "every declared mesh axis" (a ``shard_map``
+    with no literal ``axis_names=`` — the mesh argument is dynamic, and
+    shard_map makes all of its axes manual)."""
+
+    owner: str                       # enclosing def qualname ("" = module)
+    target: str                      # alias-resolved dotted name of bound fn
+    axes: list | None = None
+    lineno: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AxisBinding":
+        return cls(**d)
+
+
+@dataclass
 class ModuleSummary:
     module: str                      # dotted name
     relpath: str
@@ -208,6 +383,8 @@ class ModuleSummary:
     size: int = 0
     aliases: dict[str, str] = field(default_factory=dict)
     functions: dict[str, FunctionSummary] = field(default_factory=dict)
+    axis_funcs: dict[str, AxisFuncInfo] = field(default_factory=dict)
+    axis_bindings: list[AxisBinding] = field(default_factory=list)
     parse_error: bool = False
 
     def to_dict(self) -> dict:
@@ -220,6 +397,10 @@ class ModuleSummary:
             "functions": {
                 k: f.to_dict() for k, f in self.functions.items()
             },
+            "axis_funcs": {
+                k: a.to_dict() for k, a in self.axis_funcs.items()
+            },
+            "axis_bindings": [b.to_dict() for b in self.axis_bindings],
             "parse_error": self.parse_error,
         }
 
@@ -235,6 +416,13 @@ class ModuleSummary:
             k: FunctionSummary.from_dict(f)
             for k, f in d.get("functions", {}).items()
         }
+        out.axis_funcs = {
+            k: AxisFuncInfo.from_dict(a)
+            for k, a in d.get("axis_funcs", {}).items()
+        }
+        out.axis_bindings = [
+            AxisBinding.from_dict(b) for b in d.get("axis_bindings", [])
+        ]
         return out
 
 
@@ -256,10 +444,17 @@ class _FunctionSummarizer:
             qualname=qualname, lineno=fn.lineno, params=params,
             traced=any(_decorator_traces(d) for d in fn.decorator_list),
         )
+        for dec in fn.decorator_list:
+            donated = _decorator_donation(dec, params)
+            if donated:
+                self.summary.donated_argnums = sorted(set(donated))
+                break
         # local provenance: name -> reason string ("" = device, why)
         self.device_vars: dict[str, str] = {}
         # name -> pending callee (result of an unresolved call)
         self.pending_vars: dict[str, str] = {}
+        # name -> donated argnums of the donating jit bound to it
+        self.donating_vars: dict[str, tuple[int, ...]] = {}
         self.has_device_put = False
         self.yields_any = False
 
@@ -317,13 +512,18 @@ class _FunctionSummarizer:
                 if isinstance(sub, ast.Name):
                     names.append(sub.id)
         prov, reason, pending = self._provenance(value)
+        donated = donation_of_call(value) if isinstance(value, ast.Call) \
+            else None
         for n in names:
             self.device_vars.pop(n, None)
             self.pending_vars.pop(n, None)
+            self.donating_vars.pop(n, None)
             if prov:
                 self.device_vars[n] = reason
             elif pending:
                 self.pending_vars[n] = pending
+            if donated:
+                self.donating_vars[n] = donated
 
     def _note_return(self, expr: ast.AST) -> None:
         prov, reason, pending = self._provenance(expr)
@@ -332,6 +532,15 @@ class _FunctionSummarizer:
             self.summary.device_reason = reason
         elif pending and pending not in self.summary.returns_calls:
             self.summary.returns_calls.append(pending)
+        donated = None
+        if isinstance(expr, ast.Call):
+            donated = donation_of_call(expr)
+        elif isinstance(expr, ast.Name):
+            donated = self.donating_vars.get(expr.id)
+        if donated:
+            self.summary.returns_donating = sorted(
+                set(self.summary.returns_donating) | set(donated)
+            )
 
     # -- expression analysis --------------------------------------------
 
@@ -352,6 +561,22 @@ class _FunctionSummarizer:
             resolved = resolve_dotted(_dotted(node.func), self.aliases)
             if resolved in ("jax.device_put",):
                 self.has_device_put = True
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in self.donating_vars:
+                # forwarding an own param into a donated position of a
+                # locally-built donating jit: this function is a donation
+                # WRAPPER — an outer jit() around it drops the donation
+                for pos in self.donating_vars[node.func.id]:
+                    if pos < len(node.args) and isinstance(
+                        node.args[pos], ast.Name
+                    ) and node.args[pos].id in self.summary.params:
+                        own = self.summary.params.index(node.args[pos].id)
+                        if own not in self.summary.forwards_donated:
+                            self.summary.forwards_donated.append(own)
+                            self.summary.forwards_donated_via[str(own)] = (
+                                f"a jit(donate_argnums=...) bound to "
+                                f"{node.func.id!r} (argument {pos})"
+                            )
             base, _, attr = resolved.rpartition(".")
             if base == "jax.random" and attr in _KEY_CONSUMERS:
                 key_arg = node.args[0] if node.args else None
@@ -447,7 +672,110 @@ def summarize_module(tree: ast.Module, relpath: str) -> ModuleSummary:
                 visit(node.body, f"{prefix}{node.name}.")
 
     visit(tree.body, "")
+    out.axis_funcs, out.axis_bindings = scan_axis_info(tree, aliases)
     return out
+
+
+def def_qualnames(tree: ast.Module) -> dict[int, str]:
+    """id(def node) -> dot-joined qualname, for EVERY def (nested included,
+    classes joined without a marker: ``Trainer.fit.step``) — the naming
+    scheme the axis tables use. Rules resolve an AST site back to its
+    axis-environment entry through this map."""
+    out: dict[int, str] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                qual = f"{prefix}{child.name}"
+                out[id(child)] = qual
+                visit(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def scan_axis_info(
+    tree: ast.Module, aliases: dict[str, str]
+) -> tuple[dict[str, AxisFuncInfo], list[AxisBinding]]:
+    """Collect, for one module: every def's literal-axis collectives and
+    direct callees (:class:`AxisFuncInfo`, nested defs included), plus the
+    named-axis binding applications (:class:`AxisBinding`). Pure AST."""
+    funcs: dict[str, AxisFuncInfo] = {}
+    bindings: list[AxisBinding] = []
+
+    def binder_axes(call: ast.Call):
+        """-> tuple of axes, None (= all mesh axes), or False (no named
+        binding here)."""
+        name = _last(_dotted(call.func))
+        if name == "shard_map":
+            for kw in call.keywords:
+                if kw.arg == "axis_names":
+                    axes = _literal_str_tuple(kw.value)
+                    if axes:
+                        return axes
+                    return None  # dynamic axis_names: assume all mesh axes
+            return None
+        # vmap/pmap bind one named axis only when axis_name= is spelled
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                axes = _literal_str_tuple(kw.value)
+                if axes:
+                    return axes
+        return False
+
+    def handle_call(call: ast.Call, owner: str) -> None:
+        name = _last(_dotted(call.func))
+        pos = COLLECTIVE_AXIS_POS.get(name)
+        if pos is not None and owner:
+            axis_arg = None
+            for kw in call.keywords:
+                if kw.arg in COLLECTIVE_AXIS_KWARGS:
+                    axis_arg = kw.value
+            if axis_arg is None and len(call.args) > pos:
+                axis_arg = call.args[pos]
+            for ax in _literal_str_tuple(axis_arg) or ():
+                funcs[owner].collectives.append(
+                    (name, ax, call.lineno, call.col_offset)
+                )
+        if name in _AXIS_BINDERS and call.args:
+            axes = binder_axes(call)
+            if axes is not False:
+                target = resolve_dotted(_dotted(call.args[0]), aliases)
+                if target:
+                    bindings.append(AxisBinding(
+                        owner=owner, target=target,
+                        axes=list(axes) if axes is not None else None,
+                        lineno=call.lineno,
+                    ))
+        elif owner:
+            resolved = resolve_dotted(_dotted(call.func), aliases)
+            if resolved and not resolved.startswith(("jax.", "numpy.")) \
+                    and resolved not in funcs[owner].calls:
+                funcs[owner].calls.append(resolved)
+
+    # explicit stack (not recursion): this walk visits every node of every
+    # module on a cold run — call overhead is the budget's margin
+    stack: list[tuple[ast.AST, str, str]] = [(tree, "", "")]
+    while stack:
+        node, owner, prefix = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                qual = f"{prefix}{child.name}"
+                funcs[qual] = AxisFuncInfo(
+                    qualname=qual, lineno=child.lineno, parent=owner,
+                )
+                stack.append((child, qual, f"{qual}."))
+            elif isinstance(child, ast.ClassDef):
+                stack.append((child, owner, f"{prefix}{child.name}."))
+            else:
+                if isinstance(child, ast.Call):
+                    handle_call(child, owner)
+                stack.append((child, owner, prefix))
+    return funcs, bindings
 
 
 # ---- mesh declaration (GL012/GL015/GL007 shared scrape) ---------------------
@@ -525,7 +853,11 @@ def scrape_mesh_decl(tree: ast.Module) -> MeshDecl:
 # ---- the index --------------------------------------------------------------
 
 CACHE_NAME = ".graftlint_cache.json"
-_CACHE_VERSION = 2
+# v3: axis-environment tables (axis_funcs/axis_bindings) + donation facts
+# (donated_argnums/returns_donating/forwards_donated) joined the summaries.
+# A version mismatch discards the cache wholesale — cold start, never a
+# half-read of the old schema.
+_CACHE_VERSION = 3
 _FIXPOINT_MAX_ROUNDS = 25
 
 
@@ -548,6 +880,16 @@ class ProjectIndex:
         # dotted function name ("<module>.<qual>") -> summary
         self.functions: dict[str, FunctionSummary] = {}
         self._suffix_cache: dict[str, str | None] = {}
+        self._fn_by_last: dict[str, list[str]] | None = None
+        # axis-environment tables: "<module>.<qualname>" (nested defs
+        # included) -> info / may-bound axes / has-known-calling-context
+        self.axis_funcs: dict[str, AxisFuncInfo] = {}
+        self.axis_env: dict[str, frozenset] = {}
+        self.axis_context: dict[str, bool] = {}
+        self._axis_suffix_cache: dict[tuple[str, str], str | None] = {}
+        self._axis_by_last: dict[str, list[str]] | None = None
+        self.donation_names: frozenset = frozenset()
+        self.key_consumer_names: frozenset = frozenset()
         # (source, tree) for files parsed THIS run (cache misses): pass 2
         # adopts them instead of re-parsing
         self.parsed: dict[str, tuple[str, ast.Module]] = {}
@@ -609,7 +951,25 @@ class ProjectIndex:
         for module in index.modules.values():
             for qual, fn in module.functions.items():
                 index.functions[f"{module.module}.{qual}"] = fn
+            for qual, info in module.axis_funcs.items():
+                index.axis_funcs[f"{module.module}.{qual}"] = info
         index._fixpoint()
+        index._axis_fixpoint()
+        # cheap pre-filter for GL017: the last segments of every function
+        # carrying a donation fact — callers only pay a lookup when a
+        # callee's bare name can possibly match one
+        index.donation_names = frozenset(
+            name.rsplit(".", 1)[-1]
+            for name, fn in index.functions.items()
+            if fn.donated_argnums or fn.forwards_donated
+            or fn.returns_donating
+        )
+        # same trick for GL014: last segments of key-consuming functions
+        index.key_consumer_names = frozenset(
+            name.rsplit(".", 1)[-1]
+            for name, fn in index.functions.items()
+            if fn.key_params_consumed
+        )
         if cache_path and dirty:
             _save_cache(cache_path, {"version": _CACHE_VERSION,
                                      "files": entries})
@@ -631,8 +991,15 @@ class ProjectIndex:
         if hit is not None:
             return dotted, hit
         if dotted not in self._suffix_cache:
+            by_last = self._fn_by_last
+            if by_last is None:
+                by_last = {}
+                for k in self.functions:
+                    by_last.setdefault(_last(k), []).append(k)
+                self._fn_by_last = by_last
             suffix = "." + dotted
-            matches = [k for k in self.functions if k.endswith(suffix)]
+            matches = [k for k in by_last.get(_last(dotted), ())
+                       if k.endswith(suffix)]
             self._suffix_cache[dotted] = (
                 matches[0] if len(matches) == 1 else None
             )
@@ -667,6 +1034,51 @@ class ProjectIndex:
             return mod.aliases
         return import_aliases(tree, module_name_for(relpath))
 
+    def _axis_lookup(self, module: str, dotted: str) -> str | None:
+        """Resolve a callee/binding-target name to its axis-table entry:
+        module-local exact first, then unique suffix (same-module matches
+        preferred — a bare nested name like ``body`` resolves to THIS
+        module's ``make_step.body``, never another module's)."""
+        if not dotted:
+            return None
+        key = (module, dotted)
+        if key not in self._axis_suffix_cache:
+            hit: str | None = None
+            local = f"{module}.{dotted}"
+            if dotted in self.axis_funcs:
+                hit = dotted           # already a full indexed name
+            elif local in self.axis_funcs:
+                hit = local
+            else:
+                # bucket by last segment: the suffix scan only ever walks
+                # same-named entries, not the whole table
+                by_last = self._axis_by_last
+                if by_last is None:
+                    by_last = {}
+                    for k in self.axis_funcs:
+                        by_last.setdefault(_last(k), []).append(k)
+                    self._axis_by_last = by_last
+                suffix = "." + dotted
+                matches = [k for k in by_last.get(_last(dotted), ())
+                           if k.endswith(suffix)]
+                same_mod = [m for m in matches
+                            if m.startswith(module + ".")]
+                pool = same_mod or matches
+                hit = pool[0] if len(pool) == 1 else None
+            self._axis_suffix_cache[key] = hit
+        return self._axis_suffix_cache[key]
+
+    def axis_env_of(self, module: str,
+                    qualname: str) -> tuple[frozenset, bool]:
+        """(may-bound axes, has-known-calling-context) for one def. The
+        axis set is the union over every known binding application and
+        call path reaching the def; the flag is False when the tree shows
+        NO way to reach it (an entry point — its runtime context is
+        unknowable, so axis rules stay quiet)."""
+        full = f"{module}.{qualname}"
+        return (self.axis_env.get(full, frozenset()),
+                self.axis_context.get(full, False))
+
     # -- cross-module fixpoint ------------------------------------------
 
     def _fixpoint(self) -> None:
@@ -693,22 +1105,60 @@ class ProjectIndex:
                             )
                             changed = True
                             break
-                # transitive key consumption through consuming callees
+                # returns_donating through factory-of-factory returns
+                for callee in fn.returns_calls:
+                    hit = self.lookup_from(mod, callee)
+                    target = hit[1] if hit else None
+                    if target is not None and target.returns_donating:
+                        merged = sorted(set(fn.returns_donating)
+                                        | set(target.returns_donating))
+                        if merged != fn.returns_donating:
+                            fn.returns_donating = merged
+                            changed = True
+                # transitive key consumption through consuming callees,
+                # and donation forwarding through wrapper callees
                 for site in fn.calls:
                     hit = self.lookup_from(mod, site.callee)
                     target = hit[1] if hit else None
-                    if target is None or not target.key_params_consumed:
+                    if target is None:
                         continue
+                    donated_pos = set(target.donated_argnums) | set(
+                        target.forwards_donated
+                    )
                     for i, p in enumerate(site.arg_params):
-                        if p is None or p in fn.key_params_consumed:
+                        if p is None:
                             continue
-                        if i < len(target.params) and \
+                        if i in donated_pos:
+                            own = fn.params.index(p)
+                            if own not in fn.forwards_donated:
+                                fn.forwards_donated.append(own)
+                                via = target.forwards_donated_via.get(
+                                    str(i), ""
+                                )
+                                chain = f"{site.callee}() (argument {i}"
+                                chain += f", via {via})" if via else ")"
+                                fn.forwards_donated_via[str(own)] = chain
+                                changed = True
+                        if p in fn.key_params_consumed:
+                            continue
+                        if target.key_params_consumed and \
+                                i < len(target.params) and \
                                 target.params[i] in \
                                 target.key_params_consumed:
                             fn.key_params_consumed.append(p)
                             fn.key_consumed_via[p] = site.callee
                             changed = True
                     for kw, p in site.kw_params.items():
+                        if kw in target.params and \
+                                target.params.index(kw) in donated_pos \
+                                and fn.params.index(p) not in \
+                                fn.forwards_donated:
+                            own = fn.params.index(p)
+                            fn.forwards_donated.append(own)
+                            fn.forwards_donated_via[str(own)] = (
+                                f"{site.callee}() (argument {kw!r})"
+                            )
+                            changed = True
                         if p in fn.key_params_consumed:
                             continue
                         if kw in target.key_params_consumed:
@@ -717,6 +1167,60 @@ class ProjectIndex:
                             changed = True
             if not changed:
                 return
+
+    def _axis_fixpoint(self) -> None:
+        """Abstract interpretation over the axis tables: compute, per def,
+        the union of named axes bound on at least one reachable path
+        (binding applications seed, call edges and lexical nesting
+        propagate). Monotone over a finite axis universe — terminates."""
+        env: dict[str, set] = {k: set() for k in self.axis_funcs}
+        ctx: dict[str, bool] = {k: False for k in self.axis_funcs}
+        mesh_axes = set(self.mesh.axes)
+
+        bind_edges: list[tuple[str | None, str, set]] = []
+        call_edges: list[tuple[str, str]] = []
+        lex_edges: list[tuple[str, str]] = []
+        for mod in self.modules.values():
+            for b in mod.axis_bindings:
+                t = self._axis_lookup(mod.module, b.target)
+                if t is None:
+                    continue
+                owner = f"{mod.module}.{b.owner}" if b.owner else None
+                owner = owner if owner in env else None
+                axes = set(b.axes) if b.axes is not None else mesh_axes
+                bind_edges.append((owner, t, axes))
+                ctx[t] = True
+            for qual, info in mod.axis_funcs.items():
+                full = f"{mod.module}.{qual}"
+                if info.parent:
+                    parent = f"{mod.module}.{info.parent}"
+                    if parent in env:
+                        lex_edges.append((parent, full))
+                for callee in info.calls:
+                    t = self._axis_lookup(mod.module, callee)
+                    if t is not None and t != full:
+                        call_edges.append((full, t))
+                        ctx[t] = True
+
+        for _ in range(_FIXPOINT_MAX_ROUNDS):
+            changed = False
+            for owner, t, axes in bind_edges:
+                add = axes | (env[owner] if owner else set())
+                if add - env[t]:
+                    env[t] |= add
+                    changed = True
+            for caller, t in call_edges:
+                if env[caller] - env[t]:
+                    env[t] |= env[caller]
+                    changed = True
+            for parent, child in lex_edges:
+                if env[parent] - env[child]:
+                    env[child] |= env[parent]
+                    changed = True
+            if not changed:
+                break
+        self.axis_env = {k: frozenset(v) for k, v in env.items()}
+        self.axis_context = ctx
 
 
 def _summarize_path(

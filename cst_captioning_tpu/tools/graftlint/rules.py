@@ -15,8 +15,10 @@ import os
 import re
 
 from cst_captioning_tpu.tools.graftlint.core import (
+    Edit,
     FileContext,
     Finding,
+    Fix,
     ProjectRule,
     Rule,
     register,
@@ -27,7 +29,11 @@ from cst_captioning_tpu.tools.graftlint.project import (
     _decorator_traces,
     _dotted,
     _last,
+    COLLECTIVE_AXIS_KWARGS,
+    COLLECTIVE_AXIS_POS,
     ProjectIndex,
+    def_qualnames,
+    donation_of_call,
     resolve_dotted,
 )
 
@@ -58,17 +64,15 @@ def traced_node_ids(ctx: FileContext) -> set[int]:
         return cached
 
     name_defs: dict[str, list[ast.AST]] = {}
-    for node in ctx.walk_nodes():
-        if isinstance(node, _FUNC_NODES):
-            name_defs.setdefault(node.name, []).append(node)
+    for node in ctx.nodes_of(*_FUNC_NODES):
+        name_defs.setdefault(node.name, []).append(node)
 
     entries: list[ast.AST] = []
-    for node in ctx.walk_nodes():
-        if isinstance(node, _FUNC_NODES) and any(
-            _decorator_traces(d) for d in node.decorator_list
-        ):
+    for node in ctx.nodes_of(*_FUNC_NODES):
+        if any(_decorator_traces(d) for d in node.decorator_list):
             entries.append(node)
-        if isinstance(node, ast.Call) and _is_tracer_call(node):
+    for node in ctx.nodes_of(ast.Call):
+        if _is_tracer_call(node):
             args = list(node.args) + [kw.value for kw in node.keywords]
             for arg in args:
                 if isinstance(arg, ast.Lambda):
@@ -130,7 +134,7 @@ class HostSyncRule(Rule):
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
         traced = traced_node_ids(ctx)
-        for node in ctx.walk_nodes():
+        for node in ctx.nodes_of(ast.Call):
             prim = _sync_call(node)
             if prim and id(node) in traced:
                 out.append(ctx.finding(
@@ -160,7 +164,7 @@ class HostSyncRule(Rule):
             or isinstance(n, ast.ImportFrom) and (n.module or "").split(
                 "."
             )[0] == "jax"
-            for n in ctx.walk_nodes()
+            for n in ctx.nodes_of(ast.Import, ast.ImportFrom)
         )
 
     def _check_step_loops(self, ctx: FileContext,
@@ -169,9 +173,7 @@ class HostSyncRule(Rule):
         statements and `if` tests, but not gated `if` bodies (logging every N
         steps is a deliberate, amortized sync)."""
         out: dict[tuple[int, int, str], Finding] = {}
-        for loop in ctx.walk_nodes():
-            if not isinstance(loop, (ast.For, ast.While)):
-                continue
+        for loop in ctx.nodes_of(ast.For, ast.While):
             if id(loop) in traced:
                 continue  # the traced-scope pass above already covers these
             stack: list[ast.AST] = list(loop.body)
@@ -236,14 +238,14 @@ class KeyReuseRule(Rule):
     )
 
     def applies(self, ctx: FileContext) -> bool:
-        # tests reuse keys deliberately (determinism assertions)
-        return not _is_test_file(ctx)
+        # tests reuse keys deliberately (determinism assertions); a file
+        # that never spells a jax.random base cannot consume a key
+        return not _is_test_file(ctx) and "random" in ctx.source
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ctx.walk_nodes():
-            if isinstance(node, _FUNC_NODES):
-                out.extend(self._check_function(ctx, node))
+        for node in ctx.nodes_of(*_FUNC_NODES):
+            out.extend(self._check_function(ctx, node))
         return out
 
     def _check_function(self, ctx: FileContext, fn: ast.AST) -> list[Finding]:
@@ -344,8 +346,8 @@ class TracedBranchRule(Rule):
     def check(self, ctx: FileContext) -> list[Finding]:
         traced = traced_node_ids(ctx)
         out: list[Finding] = []
-        for fn in ctx.walk_nodes():
-            if not isinstance(fn, _FUNC_NODES) or id(fn) not in traced:
+        for fn in ctx.nodes_of(*_FUNC_NODES):
+            if id(fn) not in traced:
                 continue
             tensor_names: set[str] = set()
             for node in ast.iter_child_nodes(fn):
@@ -412,20 +414,20 @@ class DonationRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        enclosing = _enclosing_function_names(ctx)
-        for node in ctx.walk_nodes():
-            if isinstance(node, _FUNC_NODES):
-                # a *train* step carries mutable state (params/optimizer);
-                # decode/eval "step" functions don't, and donating their
-                # inputs buys nothing — require a state-like parameter
-                has_state = any(
-                    "state" in a.arg for a in node.args.args + node.args.kwonlyargs
-                )
-                for dec in node.decorator_list:
-                    if has_state and self._jit_without_donation(dec) \
-                            and _STEP_NAME.search(node.name):
-                        out.append(self._finding(ctx, dec, node.name))
-            if isinstance(node, ast.Call) and _last(_dotted(node.func)) in (
+        enclosing: dict[int, str] | None = None  # lazy: most files don't jit
+        for node in ctx.nodes_of(*_FUNC_NODES):
+            # a *train* step carries mutable state (params/optimizer);
+            # decode/eval "step" functions don't, and donating their
+            # inputs buys nothing — require a state-like parameter
+            has_state = any(
+                "state" in a.arg for a in node.args.args + node.args.kwonlyargs
+            )
+            for dec in node.decorator_list:
+                if has_state and self._jit_without_donation(dec) \
+                        and _STEP_NAME.search(node.name):
+                    out.append(self._finding(ctx, dec, node.name))
+        for node in ctx.nodes_of(ast.Call):
+            if _last(_dotted(node.func)) in (
                 "jit", "pjit"
             ):
                 if any(kw.arg in _DONATE_KWARGS for kw in node.keywords):
@@ -433,6 +435,8 @@ class DonationRule(Rule):
                 target = ""
                 if node.args and isinstance(node.args[0], ast.Name):
                     target = node.args[0].id
+                if enclosing is None:
+                    enclosing = _enclosing_function_names(ctx)
                 owner = enclosing.get(id(node), "")
                 subject = target if _STEP_NAME.search(target) else (
                     owner if _STEP_NAME.search(owner) else ""
@@ -517,9 +521,8 @@ class F32LiteralRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ctx.walk_nodes():
-            if not isinstance(node, ast.Call):
-                continue
+        param_scopes: dict[int, frozenset] | None = None  # lazy: rare rule
+        for node in ctx.nodes_of(ast.Call):
             d = _dotted(node.func)
             base, _, attr = d.rpartition(".")
             if base not in ("jnp", "jax.numpy", "np", "numpy"):
@@ -534,12 +537,27 @@ class F32LiteralRule(Rule):
             if dtype is None and len(node.args) > pos:
                 dtype = node.args[pos]
             if dtype is not None and self._is_f32(dtype):
+                fix = None
+                if param_scopes is None:
+                    param_scopes = _enclosing_param_sets(ctx)
+                if "dtype" in param_scopes.get(id(node), frozenset()):
+                    # the enclosing function already routes a dtype: the
+                    # mechanical fix is to use it (the dtype the caller
+                    # chose — exactly what the literal was overriding)
+                    fix = Fix(
+                        edits=(Edit.from_node(dtype, "dtype"),),
+                        description=(
+                            "route the literal through the enclosing "
+                            "function's `dtype` parameter"
+                        ),
+                    )
                 out.append(ctx.finding(
                     self, node,
                     f"float32 literal via {d}(...) in a bf16-annotated "
                     "module: pass the module's compute dtype (cfg.dtype) or "
                     "suppress with a comment when f32 accumulation is the "
                     "point",
+                    fix=fix,
                 ))
         return out
 
@@ -550,6 +568,34 @@ class F32LiteralRule(Rule):
         return _dotted(node) in (
             "jnp.float32", "np.float32", "numpy.float32", "jax.numpy.float32",
         )
+
+
+def _enclosing_param_sets(ctx: FileContext) -> dict[int, frozenset]:
+    """id(node) -> parameter names of the nearest enclosing function
+    (including outer functions' params — closures see them). Cached."""
+    cached = ctx._cache.get("enclosing_params")
+    if cached is not None:
+        return cached
+    out: dict[int, frozenset] = {}
+
+    def walk(node: ast.AST, params: frozenset) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_params = params
+            if isinstance(child, _FUNC_NODES):
+                args = child.args
+                own = {a.arg for a in args.posonlyargs + args.args
+                       + args.kwonlyargs}
+                if args.vararg:
+                    own.add(args.vararg.arg)
+                if args.kwarg:
+                    own.add(args.kwarg.arg)
+                child_params = params | own
+            out[id(child)] = child_params
+            walk(child, child_params)
+
+    walk(ctx.tree, frozenset())
+    ctx._cache["enclosing_params"] = out
+    return out
 
 
 # ---- GL006: heavyweight imports / module-level device work ------------------
@@ -580,7 +626,7 @@ class HeavyImportRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ctx.walk_nodes():
+        for node in ctx.nodes_of(ast.Import, ast.ImportFrom):
             mods: list[str] = []
             if isinstance(node, ast.Import):
                 mods = [a.name for a in node.names]
@@ -762,7 +808,7 @@ class TpuTestMarkerRule(Rule):
     def check(self, ctx: FileContext) -> list[Finding]:
         tpu_import = None
         tpu_mod = ""
-        for node in ctx.walk_nodes():
+        for node in ctx.nodes_of(ast.Import, ast.ImportFrom):
             mods: list[str] = []
             if isinstance(node, ast.Import):
                 mods = [a.name for a in node.names]
@@ -780,9 +826,8 @@ class TpuTestMarkerRule(Rule):
         if self._module_marked_slow(ctx.tree):
             return []
         unmarked = [
-            fn.name for fn in ctx.walk_nodes()
-            if isinstance(fn, _FUNC_NODES) and fn.name.startswith("test_")
-            and not self._marked_slow(fn)
+            fn.name for fn in ctx.nodes_of(*_FUNC_NODES)
+            if fn.name.startswith("test_") and not self._marked_slow(fn)
         ]
         if not unmarked:
             return []
@@ -835,9 +880,7 @@ class SwallowedExceptionRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ctx.walk_nodes():
-            if not isinstance(node, ast.Try):
-                continue
+        for node in ctx.nodes_of(ast.Try):
             for handler in node.handlers:
                 if not self._broad(handler.type):
                     continue
@@ -903,9 +946,7 @@ class AdHocTimingRule(Rule):
 
     def check(self, ctx: FileContext) -> list[Finding]:
         out: list[Finding] = []
-        for node in ctx.walk_nodes():
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes_of(ast.Call):
             d = _dotted(node.func)
             if d == "time.time":
                 out.append(ctx.finding(
@@ -956,18 +997,16 @@ class ScanCarryDtypeRule(Rule):
         out: list[Finding] = []
         defs: dict[str, ast.AST] = {}
         assigns: dict[str, ast.AST] = {}
-        for node in ctx.walk_nodes():
-            if isinstance(node, _FUNC_NODES):
-                defs[node.name] = node
-            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+        for node in ctx.nodes_of(*_FUNC_NODES):
+            defs[node.name] = node
+        for node in ctx.nodes_of(ast.Assign):
+            if len(node.targets) == 1:
                 tgt = node.targets[0]
                 if isinstance(tgt, ast.Name):
                     # last write wins — good enough for the literal inits
                     # this rule reasons about
                     assigns[tgt.id] = node.value
-        for node in ctx.walk_nodes():
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes_of(ast.Call):
             kind = _last(_dotted(node.func))
             if kind == "scan" and len(node.args) >= 2:
                 body_arg, init_arg = node.args[0], node.args[1]
@@ -983,7 +1022,7 @@ class ScanCarryDtypeRule(Rule):
                 ret_leaves = self._leaves(ret, assigns)
                 if len(init_leaves) != len(ret_leaves):
                     continue  # structure unknown — out of scope
-                for (d_init, _), (d_ret, leaf) in zip(
+                for (d_init, init_leaf), (d_ret, leaf) in zip(
                     init_leaves, ret_leaves
                 ):
                     if d_init and d_ret and d_init != d_ret:
@@ -994,8 +1033,56 @@ class ScanCarryDtypeRule(Rule):
                             "carry dtype must be loop-invariant — cast the "
                             "init (or drop the per-iteration cast) so "
                             "input and output types agree",
+                            fix=self._init_dtype_fix(
+                                init_leaf, d_ret, assigns
+                            ),
                         ))
         return out
+
+    @classmethod
+    def _init_dtype_fix(cls, init_node, d_ret: str, assigns) -> Fix | None:
+        """Rewrite the init's dtype LITERAL to the dtype the body already
+        returns — the mechanical half of the rule's prescription (the
+        body's dtype is what the computation produces; the init is the
+        stale literal). Spelled in the literal's own style; inits without
+        an explicit literal stay manual."""
+        if isinstance(init_node, ast.Name) and init_node.id in assigns:
+            init_node = assigns[init_node.id]
+        if not isinstance(init_node, ast.Call):
+            return None
+        dtype_node = None
+        if isinstance(init_node.func, ast.Attribute) and \
+                init_node.func.attr == "astype" and init_node.args:
+            dtype_node = init_node.args[0]
+        else:
+            for kw in init_node.keywords:
+                if kw.arg == "dtype":
+                    dtype_node = kw.value
+            if dtype_node is None:
+                name = _last(_dotted(init_node.func))
+                if name in ("zeros", "ones", "empty") and \
+                        len(init_node.args) >= 2:
+                    dtype_node = init_node.args[1]
+        if dtype_node is None or not d_ret.isidentifier():
+            return None
+        if isinstance(dtype_node, ast.Constant) and isinstance(
+            dtype_node.value, str
+        ):
+            replacement = repr(d_ret)
+        else:
+            d = _dotted(dtype_node)
+            if not d:
+                return None
+            base = d.rpartition(".")[0]
+            replacement = f"{base}.{d_ret}" if base else d_ret
+        return Fix(
+            edits=(Edit.from_node(dtype_node, replacement),),
+            description=(
+                f"rewrite the carry init's dtype literal to {d_ret!r} "
+                "(the dtype the body returns) so the carry dtype is "
+                "loop-invariant"
+            ),
+        )
 
     @staticmethod
     def _resolve(arg, defs):
@@ -1075,13 +1162,29 @@ class ScanCarryDtypeRule(Rule):
 
 # ---- GL012: collective-axis-name typos --------------------------------------
 
-# collective -> positional index of its axis-name argument
-_GL012_COLLECTIVES = {
-    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
-    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
-    "pbroadcast": 1, "pcast": 1, "axis_index": 0,
-}
-_GL012_AXIS_KWARGS = ("axis_name",)
+# canonical table lives in project.py (the axis-environment scan shares it)
+_GL012_COLLECTIVES = COLLECTIVE_AXIS_POS
+_GL012_AXIS_KWARGS = COLLECTIVE_AXIS_KWARGS
+
+
+def _enclosing_def_quals(ctx: FileContext) -> dict[int, str]:
+    """id(any node) -> qualname of the nearest enclosing def (the axis
+    tables' naming scheme), '' at module/class scope. Cached per file."""
+    cached = ctx._cache.get("enclosing_def_quals")
+    if cached is not None:
+        return cached
+    quals = def_qualnames(ctx.tree)
+    out: dict[int, str] = {}
+
+    def walk(node: ast.AST, owner: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_owner = quals.get(id(child), owner)
+            out[id(child)] = child_owner
+            walk(child, child_owner)
+
+    walk(ctx.tree, "")
+    ctx._cache["enclosing_def_quals"] = out
+    return out
 
 
 @register
@@ -1093,7 +1196,9 @@ class CollectiveAxisRule(ProjectRule):
         "a psum/pmean/all_gather over a misspelled mesh axis name only "
         "fails at trace time deep inside shard_map (unbound axis) — or, "
         "with nested meshes, silently reduces over the WRONG axis; literal "
-        "axis names are checked against the axes train/mesh.py declares"
+        "axis names are checked against the axes train/mesh.py declares, "
+        "plus any axis the call path visibly binds (vmap/pmap axis_name) — "
+        "GL016 owns the scoped is-it-actually-bound check"
     )
 
     def applies(self, ctx: FileContext) -> bool:
@@ -1107,13 +1212,15 @@ class CollectiveAxisRule(ProjectRule):
         # session can never lint against stale axes
         out: list[Finding] = []
         allowed = index.mesh.axes
-        for node in ctx.walk_nodes():
-            if not isinstance(node, ast.Call):
-                continue
+        module = index.module_of(ctx.relpath)
+        enclosing: dict[int, str] | None = None  # built on first collective
+        for node in ctx.nodes_of(ast.Call):
             name = _last(_dotted(node.func))
             pos = _GL012_COLLECTIVES.get(name)
             if pos is None:
                 continue
+            if enclosing is None:
+                enclosing = _enclosing_def_quals(ctx)
             axis_arg = None
             for kw in node.keywords:
                 if kw.arg in _GL012_AXIS_KWARGS:
@@ -1121,15 +1228,25 @@ class CollectiveAxisRule(ProjectRule):
             if axis_arg is None and len(node.args) > pos:
                 axis_arg = node.args[pos]
             for axis in self._axis_literals(axis_arg):
-                if axis not in allowed:
-                    out.append(ctx.finding(
-                        self, node,
-                        f"{name}(...) over axis {axis!r}, which is not a "
-                        "mesh axis train/mesh.py declares "
-                        f"({', '.join(sorted(allowed))}): a typo here is an "
-                        "unbound-axis trace error at best and a wrong-axis "
-                        "reduction at worst",
-                    ))
+                if axis in allowed:
+                    continue
+                # an axis some reachable caller BINDS (vmap(axis_name=)/
+                # pmap) is not a typo even though the mesh never declares
+                # it — the axis-environment pass (GL016's substrate) knows
+                qual = enclosing.get(id(node), "")
+                if qual:
+                    env, _ = index.axis_env_of(module, qual)
+                    if axis in env:
+                        continue
+                out.append(ctx.finding(
+                    self, node,
+                    f"{name}(...) over axis {axis!r}, which is not a "
+                    "mesh axis train/mesh.py declares "
+                    f"({', '.join(sorted(allowed))}) nor an axis any "
+                    "reachable caller binds: a typo here is an "
+                    "unbound-axis trace error at best and a wrong-axis "
+                    "reduction at worst",
+                ))
         return out
 
     @staticmethod
@@ -1182,6 +1299,15 @@ class _DeviceFlow:
         self.device_vars: dict[str, str] = {}   # name -> provenance chain
         self.findings: list[Finding] = []
         self._reported: set[tuple[int, int]] = set()
+        # the spelling of jax.device_get THIS file can use (autofix): the
+        # plain `import jax` alias, or a direct `from jax import device_get`
+        self.device_get_spelling = ""
+        for local, target in aliases.items():
+            if target == "jax":
+                self.device_get_spelling = f"{local}.device_get"
+                break
+            if target == "jax.device_get" and not self.device_get_spelling:
+                self.device_get_spelling = local
 
     # -- provenance ------------------------------------------------------
 
@@ -1354,7 +1480,7 @@ class _DeviceFlow:
                     f"{sink}(...) on a device-resident value forces an "
                     "implicit device→host transfer"
                 )
-                fix = (
+                hint = (
                     "read it back explicitly with jax.device_get (one "
                     "visible sync) or keep the math in jnp"
                 )
@@ -1363,11 +1489,34 @@ class _DeviceFlow:
                     f"{sink}(...) re-wraps a value that is already on "
                     "device — at best a no-op, at worst a hidden copy/cast"
                 )
-                fix = "drop the conversion (or make the cast explicit)"
+                hint = "drop the conversion (or make the cast explicit)"
             self._report(
                 node,
-                f"{msg}: {self._describe(node.args[0])} ← {chain}; {fix}",
+                f"{msg}: {self._describe(node.args[0])} ← {chain}; {hint}",
+                fix=self._asarray_fix(node, attr)
+                if sink.startswith("np.") else None,
             )
+
+    def _asarray_fix(self, node: ast.Call, attr: str) -> Fix | None:
+        """``np.asarray(x)`` -> ``jax.device_get(x)``: both return a host
+        numpy array of the same values, but device_get is the EXPLICIT
+        readback spelling (behavior-identical, sanitizer-legal). Only the
+        bare single-argument form is mechanical; dtype=/copy= kwargs
+        change semantics and stay manual."""
+        if attr != "asarray" or not self.device_get_spelling:
+            return None
+        if len(node.args) != 1 or node.keywords:
+            return None
+        return Fix(
+            edits=(Edit.from_node(
+                node.func, self.device_get_spelling
+            ),),
+            description=(
+                f"replace {self._describe(node.func)}(...) with "
+                f"{self.device_get_spelling}(...) — the same host "
+                "readback, made explicit"
+            ),
+        )
 
     def _describe(self, expr: ast.AST) -> str:
         try:
@@ -1376,12 +1525,13 @@ class _DeviceFlow:
             src = "<expr>"
         return src if len(src) <= 40 else src[:37] + "…"
 
-    def _report(self, node: ast.AST, message: str) -> None:
+    def _report(self, node: ast.AST, message: str,
+                fix: Fix | None = None) -> None:
         key = (node.lineno, node.col_offset)
         if key not in self._reported:
             self._reported.add(key)
             self.findings.append(
-                self.ctx.finding(self.rule, node, message)
+                self.ctx.finding(self.rule, node, message, fix=fix)
             )
 
 
@@ -1414,8 +1564,8 @@ class ImplicitTransferRule(ProjectRule):
         # (traced scopes belong to GL001: inside a trace these calls are a
         # trace error, not a quiet transfer)
         scopes: list[list[ast.stmt]] = [ctx.tree.body]
-        for node in ctx.walk_nodes():
-            if isinstance(node, _FUNC_NODES) and id(node) not in traced:
+        for node in ctx.nodes_of(*_FUNC_NODES):
+            if id(node) not in traced:
                 scopes.append(node.body)
         for body in scopes:
             out.extend(_DeviceFlow(self, ctx, index, aliases).run(body))
@@ -1445,11 +1595,10 @@ class CrossFunctionKeyReuseRule(ProjectRule):
         aliases = index.aliases_for(ctx.relpath, ctx.tree)
         module = index.module_of(ctx.relpath)
         out: list[Finding] = []
-        for node in ctx.walk_nodes():
-            if isinstance(node, _FUNC_NODES):
-                out.extend(
-                    self._check_function(ctx, index, aliases, module, node)
-                )
+        for node in ctx.nodes_of(*_FUNC_NODES):
+            out.extend(
+                self._check_function(ctx, index, aliases, module, node)
+            )
         return out
 
     def _check_function(self, ctx: FileContext, index: ProjectIndex,
@@ -1531,6 +1680,8 @@ class CrossFunctionKeyReuseRule(ProjectRule):
             return
         if resolved.startswith(("jax.", "numpy.")):
             return
+        if _last(resolved) not in index.key_consumer_names:
+            return  # no key-consuming function anywhere shares the name
         hit = index.lookup_from(module, resolved)
         if hit is None or not hit[1].key_params_consumed:
             return
@@ -1598,9 +1749,7 @@ class ShardingSpecDriftRule(ProjectRule):
         aliases = index.aliases_for(ctx.relpath, ctx.tree)
         allowed = index.mesh.axes
         out: list[Finding] = []
-        for node in ctx.walk_nodes():
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes_of(ast.Call):
             resolved = resolve_dotted(_dotted(node.func), aliases)
             if resolved not in _GL015_SPEC_TYPES:
                 continue
@@ -1628,4 +1777,331 @@ class ShardingSpecDriftRule(ProjectRule):
                         elt.value, str
                     ):
                         out.append((elt.value, elt))
+        return out
+
+
+# ---- GL016: collective over a declared-but-unbound axis ---------------------
+
+@register
+class CollectiveAxisScopeRule(ProjectRule):
+    id = "GL016"
+    name = "collective-axis-unbound-in-scope"
+    severity = "error"
+    rationale = (
+        "a psum/pmean/all_gather over a mesh axis that NO reachable "
+        "calling context binds (no shard_map/vmap(axis_name=)/pmap on any "
+        "call path) is an unbound-axis error the moment it runs — GL012 "
+        "cannot see this (the axis IS declared); the per-function axis "
+        "environments propagated through the call-graph fixpoint can, "
+        "including for helpers called from inside a shard_map body"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # package code only: tests/fixtures spell unbound axes on purpose
+        return _in_package(ctx) and not ctx.relpath.startswith(
+            "cst_captioning_tpu/tools/"
+        )
+
+    def check_project(self, ctx: FileContext,
+                      index: ProjectIndex) -> list[Finding]:
+        mod = index.by_relpath.get(ctx.relpath)
+        if mod is None or mod.parse_error:
+            return []
+        allowed = index.mesh.axes
+        out: list[Finding] = []
+        for qual, info in mod.axis_funcs.items():
+            if not info.collectives:
+                continue
+            env, has_context = index.axis_env_of(mod.module, qual)
+            if not has_context:
+                # no binding application and no in-tree caller: an entry
+                # point whose runtime context is unknowable — never guess
+                continue
+            for prim, axis, line, col in info.collectives:
+                if axis not in allowed:
+                    continue  # undeclared axis: that finding is GL012's
+                if axis in env:
+                    continue
+                bound = ", ".join(sorted(env)) if env else "no named axes"
+                out.append(Finding(
+                    rule=self.id, severity=self.severity,
+                    path=ctx.relpath, line=line, col=col,
+                    message=(
+                        f"{prim}(...) over axis {axis!r} inside "
+                        f"{qual}(): the axis is declared by train/mesh.py "
+                        "but NOT bound in any reachable calling context "
+                        f"(known callers bind {bound}) — at runtime this "
+                        "is an unbound-axis error; bind it on the call "
+                        "path (shard_map axis_names= / vmap(axis_name=)) "
+                        "or route the axis in as a parameter"
+                    ),
+                    context=ctx.line_text(line),
+                ))
+        return out
+
+
+# ---- GL017: interprocedural donation hazards --------------------------------
+
+class _DonationFlow:
+    """Source-order walk of one function body tracking (a) names bound to
+    DONATING callables — a literal ``jax.jit(..., donate_argnums=...)``,
+    or a factory whose summary says it returns one — and (b) buffer names
+    donated through them. A later read of a donated name is a
+    use-after-donate: at runtime the buffer is deleted and the read
+    raises. Loop bodies are walked twice so a donation on iteration one
+    is visible to reads on iteration two (the classic un-rebound
+    ``new_state = step(state, b)`` train-loop bug)."""
+
+    def __init__(self, rule: "DonationFlowRule", ctx: FileContext,
+                 index: ProjectIndex, aliases: dict[str, str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.index = index
+        self.aliases = aliases
+        self.module = index.module_of(ctx.relpath)
+        # callable name (dotted, e.g. "step" / "self._admit_fn") ->
+        # (donated argnums, human label)
+        self.donating: dict[str, tuple[tuple[int, ...], str]] = {}
+        # buffer name -> (donation line, human label)
+        self.donated: dict[str, tuple[int, str]] = {}
+        self.findings: list[Finding] = []
+        self._reported: set[tuple[int, int]] = set()
+
+    # -- resolution ------------------------------------------------------
+
+    def _callable_donation(
+        self, call: ast.Call
+    ) -> tuple[tuple[int, ...], str] | None:
+        """Donated argnums of the callable a call goes through, resolved
+        locally (a tracked binding) or through the project index (a
+        donating def / a wrapper forwarding into one)."""
+        dotted = _dotted(call.func)
+        local = self.donating.get(dotted)
+        if local is not None:
+            return local
+        if _last(dotted) not in self.index.donation_names:
+            return None  # no donating function anywhere shares the name
+        resolved = resolve_dotted(dotted, self.aliases)
+        if not resolved or resolved.startswith(("jax.", "numpy.")):
+            return None
+        hit = self.index.lookup_from(self.module, resolved)
+        if hit is None:
+            return None
+        name, summary = hit
+        positions = sorted(
+            set(summary.donated_argnums) | set(summary.forwards_donated)
+        )
+        if not positions:
+            return None
+        vias = [
+            summary.forwards_donated_via.get(str(p)) for p in positions
+        ]
+        via = next((v for v in vias if v), "")
+        label = f"{name}() (donates argument(s) {positions}"
+        label += f", via {via})" if via else ")"
+        return tuple(positions), label
+
+    def _factory_donation(self, call: ast.Call) -> tuple | None:
+        dotted = _dotted(call.func)
+        if _last(dotted) not in self.index.donation_names:
+            return None
+        resolved = resolve_dotted(dotted, self.aliases)
+        if not resolved or resolved.startswith(("jax.", "numpy.")):
+            return None
+        hit = self.index.lookup_from(self.module, resolved)
+        if hit is None or not hit[1].returns_donating:
+            return None
+        name = hit[0]
+        return (
+            tuple(hit[1].returns_donating),
+            f"the donating jit returned by {name}()",
+        )
+
+    # -- statement walk --------------------------------------------------
+
+    def run(self, body: list[ast.stmt]) -> list[Finding]:
+        for stmt in body:
+            self._stmt(stmt)
+        return self.findings
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, _FUNC_NODES + (ast.Lambda, ast.ClassDef)):
+            return  # separate scopes
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            self._bind(node.targets, node.value)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if node.value is not None:
+                self._expr(node.value)
+                self._bind([node.target], node.value)
+        elif isinstance(node, (ast.For, ast.While)):
+            if isinstance(node, ast.For):
+                self._expr(node.iter)
+            else:
+                self._expr(node.test)
+            for _ in range(2):  # donations are loop-carried
+                for stmt in node.body:
+                    self._stmt(stmt)
+            for stmt in node.orelse:
+                self._stmt(stmt)
+        elif isinstance(node, ast.If):
+            self._expr(node.test)
+            before_donated = dict(self.donated)
+            before_donating = dict(self.donating)
+            for stmt in node.body:
+                self._stmt(stmt)
+            after_body_donated = self.donated
+            after_body_donating = self.donating
+            self.donated = dict(before_donated)
+            self.donating = dict(before_donating)
+            for stmt in node.orelse:
+                self._stmt(stmt)
+            # may-join: a donation on either arm poisons later reads
+            self.donated = {**after_body_donated, **self.donated}
+            self.donating = {**after_body_donating, **self.donating}
+        elif isinstance(node, ast.expr):
+            self._expr(node)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+                else:
+                    self._stmt(child)
+
+    def _bind(self, targets: list[ast.AST], value: ast.AST) -> None:
+        donating = None
+        if isinstance(value, ast.Call):
+            argnums = donation_of_call(value)
+            if argnums:
+                donating = (argnums, "a jax.jit(donate_argnums=...) "
+                                     "built here")
+            else:
+                donating = self._factory_donation(value)
+        for t in targets:
+            # rebinding refreshes both roles of every name it touches
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Name):
+                    self.donated.pop(sub.id, None)
+                    self.donating.pop(sub.id, None)
+            dotted = _dotted(t)
+            if dotted:
+                self.donated.pop(dotted, None)
+                self.donating.pop(dotted, None)
+                if donating is not None:
+                    self.donating[dotted] = donating
+
+    def _expr(self, expr: ast.AST) -> None:
+        # one walk: reads of already-donated buffers are reported BEFORE
+        # this expression's own donations land (the canonical
+        # `state = step(state, b)` donate-and-rebind must not self-flag)
+        calls: list[ast.Call] = []
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ) and node.id in self.donated:
+                line, label = self.donated[node.id]
+                self._report(
+                    node,
+                    f"buffer {node.id!r} was donated on line {line} "
+                    f"(to {label}) and is read again here: donation "
+                    "deletes the buffer, so this read raises at runtime "
+                    "— reorder the read before the donating call, or "
+                    "rebind the name to the call's result",
+                )
+        for node in calls:
+            don = self._callable_donation(node)
+            if don is None:
+                continue
+            positions, label = don
+            for pos in positions:
+                if pos < len(node.args) and isinstance(
+                    node.args[pos], ast.Name
+                ):
+                    name = node.args[pos].id
+                    if name not in self.donated:
+                        self.donated[name] = (node.lineno, label)
+
+    def _report(self, node: ast.AST, message: str) -> None:
+        key = (node.lineno, node.col_offset)
+        if key not in self._reported:
+            self._reported.add(key)
+            self.findings.append(
+                self.ctx.finding(self.rule, node, message)
+            )
+
+
+@register
+class DonationFlowRule(ProjectRule):
+    id = "GL017"
+    name = "interprocedural-donation-hazard"
+    severity = "error"
+    rationale = (
+        "donation facts propagate through wrapper helpers: a buffer "
+        "handed to a jit(donate_argnums=...) — even through a factory or "
+        "a forwarding wrapper in another module — is DELETED, so a later "
+        "read raises at runtime; and an outer jit() around a wrapper "
+        "whose callee donates silently drops the donation (the GL004 "
+        "memory ceiling comes back with no finding at the wrapper site)"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # package code only (tests exercise donation errors on purpose),
+        # minus the linter itself
+        return _in_package(ctx) and not ctx.relpath.startswith(
+            "cst_captioning_tpu/tools/"
+        )
+
+    def check_project(self, ctx: FileContext,
+                      index: ProjectIndex) -> list[Finding]:
+        aliases = index.aliases_for(ctx.relpath, ctx.tree)
+        out: list[Finding] = []
+        # (a) use-after-donate, one flow per function scope
+        for node in ctx.nodes_of(*_FUNC_NODES):
+            out.extend(
+                _DonationFlow(self, ctx, index, aliases).run(node.body)
+            )
+        # (b) outer jit() that silently drops a wrapped donation
+        out.extend(self._dropped_donation(ctx, index, aliases))
+        return out
+
+    def _dropped_donation(self, ctx: FileContext, index: ProjectIndex,
+                          aliases: dict[str, str]) -> list[Finding]:
+        module = index.module_of(ctx.relpath)
+        out: list[Finding] = []
+        candidates: list[tuple[str, ast.AST]] = []
+        for node in ctx.nodes_of(ast.Call):
+            if _last(_dotted(node.func)) in ("jit", "pjit"):
+                if any(kw.arg in _DONATE_KWARGS for kw in node.keywords):
+                    continue  # donation (even dynamic) was a decision
+                if node.args and isinstance(node.args[0], ast.Name):
+                    candidates.append((node.args[0].id, node))
+        for node in ctx.nodes_of(*_FUNC_NODES):
+            for dec in node.decorator_list:
+                if DonationRule._jit_without_donation(dec):
+                    candidates.append((node.name, dec))
+                    break
+        for target, anchor in candidates:
+            if _last(target) not in index.donation_names:
+                continue
+            hit = index.lookup_from(module, resolve_dotted(target, aliases))
+            if hit is None or not hit[1].forwards_donated:
+                continue
+            name, summary = hit
+            pos = summary.forwards_donated[0]
+            param = summary.params[pos] if pos < len(summary.params) \
+                else f"#{pos}"
+            via = summary.forwards_donated_via.get(str(pos), "")
+            chain = f" via {via}" if via else ""
+            out.append(ctx.finding(
+                self, anchor,
+                f"jit() wraps {target!r} without donation, but "
+                f"{name}() forwards its parameter {param!r} into a "
+                f"donated position{chain}: under an outer jit the inner "
+                "donate_argnums is ignored, so the buffer silently "
+                "double-buffers again — donate at THIS jit (or drop the "
+                "inner donation)",
+                severity="warning",
+            ))
         return out

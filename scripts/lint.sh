@@ -5,23 +5,27 @@
 #
 # Exits nonzero on (a) any NEW graftlint finding — baselined findings pass,
 # see graftlint.baseline — or a stale baseline entry / unused inline
-# suppression (--check-stale), or the two-pass lint exceeding its 2 s
-# budget; (b) any file that doesn't byte-compile; (c) the obs_report /
-# decode / sanitizer smokes failing. tier-1 runs the same graftlint check
-# via tests/test_graftlint.py (test_repo_is_graftlint_clean), so CI cannot
-# drift from this script.
+# suppression (--check-stale), or an UNFIXED autofixable finding
+# (--fix-check: the repair is mechanical, so run
+# `python -m cst_captioning_tpu.tools.graftlint --fix` and commit), or the
+# two-pass lint exceeding its 2 s budget; (b) any file that doesn't
+# byte-compile; (c) the obs_report / decode / sanitizer smokes failing.
+# tier-1 runs the same graftlint check via tests/test_graftlint.py
+# (test_repo_is_graftlint_clean), so CI cannot drift from this script.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 # Two-pass AST analysis only — no JAX backend, no device. Pass 1 builds the
 # whole-program project index (mtime-keyed summary cache keeps repeat runs
-# warm), pass 2 runs the per-file + interprocedural rules. --timings prints
-# the per-pass line; --budget asserts index+rules stay under 2 s.
+# warm; now carrying the per-function axis environments and donation facts
+# that power GL016/GL017), pass 2 runs the per-file + interprocedural
+# rules. --timings prints the per-pass line; --budget asserts index+rules
+# stay under 2 s.
 python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_decode.py bench_recipe.py \
     bench_serving.py \
-    --check-stale --timings --budget 2
+    --fix-check --check-stale --timings --budget 2
 
 # catches syntax errors in files graftlint may not reach (non-.py-suffixed
 # entry points aside, this is the whole tree)

@@ -1,0 +1,33 @@
+"""GL016 cross-file fixture — helper functions with collectives.
+
+Every helper here is called from inside the shard_map body in
+``mapper.py``, which binds ONLY the 'model' axis (``axis_names=``).
+``reduce_pipeline`` reduces over 'pipeline' — a mesh axis train/mesh.py
+declares, so GL012 provably cannot flag it — but no reachable calling
+context binds it: GL016's finding. Linting this file ALONE must find
+nothing (no caller is known, so the runtime context is unknowable).
+
+Deliberately lint-dirty directory: skipped by the repo-wide walk
+(``fixtures`` is in core._SKIP_DIRS), linted explicitly by the tests.
+"""
+
+import jax
+
+
+def reduce_model(x):
+    # 'model' is bound by mapper.py's shard_map(axis_names=('model',))
+    return jax.lax.psum(x, "model")
+
+
+def reduce_pipeline(x):
+    # declared by mesh.py, NEVER bound on any call path -> GL016
+    return jax.lax.pmean(x, "pipeline")
+
+
+def reduce_pipeline_suppressed(x):
+    return jax.lax.pmean(x, "pipeline")  # graftlint: disable=GL016 (fixture: axis bound by an external caller)
+
+
+def unreached(x):
+    # no in-tree caller at all: context unknowable, GL016 stays quiet
+    return jax.lax.psum(x, "pipeline")

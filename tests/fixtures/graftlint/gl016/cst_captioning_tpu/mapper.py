@@ -1,0 +1,42 @@
+"""GL016 cross-file fixture — the shard_map APPLICATION side.
+
+``run``'s nested ``body`` is shard_mapped with ``axis_names=('model',)``
+(a partial manual-axes mapping: 'pipeline' stays automatic), then calls
+the helpers in ``collectives.py`` — so their axis environment is
+{'model'}, a fact that lives entirely in THIS module. ``vmapped`` shows
+the vmap(axis_name=) seeding path for a NON-mesh axis: 'rollout' is not
+declared by mesh.py, yet psum over it is legitimate because the call
+path visibly binds it (GL012 consults the same environment).
+"""
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+from cst_captioning_tpu.collectives import (
+    reduce_model,
+    reduce_pipeline,
+    reduce_pipeline_suppressed,
+)
+
+
+def run(mesh, xs, in_specs, out_specs):
+    def body(x):
+        a = reduce_model(x)
+        b = reduce_pipeline(x)
+        c = reduce_pipeline_suppressed(x)
+        return a + b + c
+
+    step = shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        axis_names=("model",),
+    )
+    return step(xs)
+
+
+def lane_sum(x):
+    # 'rollout' is not a mesh axis; bound only by vmapped() below
+    return jax.lax.psum(x, "rollout")
+
+
+def vmapped(xs):
+    return jax.vmap(lane_sum, axis_name="rollout")(xs)

@@ -1,0 +1,12 @@
+"""GL016 cross-file fixture — the mesh DECLARATION side.
+
+Declares axes 'model' and 'pipeline' (the string defaults of *axis
+parameters, same scrape as the real train/mesh.py). BOTH axes are
+declared, so GL012's literal-vs-mesh check passes everywhere in this
+fixture — only the axis-ENVIRONMENT analysis can tell that the
+shard_map call path binds just 'model'.
+"""
+
+
+def make_mesh(num_devices=0, axis="model", seq_axis="pipeline"):
+    return None

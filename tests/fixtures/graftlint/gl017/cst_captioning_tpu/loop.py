@@ -1,0 +1,54 @@
+"""GL017 cross-file fixture — callers of the donating side.
+
+``bad_factory_use`` re-reads a buffer after a step built by the
+``make_step`` factory donated it; ``bad_loop`` never rebinds the carry,
+so iteration two reads the buffer iteration one donated. ``local_wrapper``
+forwards its param into the donating ``fused_update``, and ``outer_jit``
+wraps it in a donation-less ``jax.jit`` — the inner donation is silently
+dropped. All three facts live in ``steps_lib.py``: linting this file
+ALONE must find nothing.
+"""
+
+import jax
+
+from cst_captioning_tpu.steps_lib import fused_update, make_step
+
+
+def bad_factory_use(state, batch):
+    step = make_step()
+    new_state = step(state, batch)
+    return new_state, state.step  # GL017: `state` was donated to step()
+
+
+def bad_loop(state, batches):
+    out = None
+    for b in batches:
+        out = fused_update(state, b)  # GL017: donated on iter 1, read on iter 2
+    return out
+
+
+def good_rebind(state, batches):
+    for b in batches:
+        state = fused_update(state, b)  # rebinding the carry is THE pattern
+    return state
+
+
+def good_read_before(state, batch):
+    step_count = state.step
+    new_state = fused_update(state, batch)
+    return new_state, step_count
+
+
+def suppressed(state, batch):
+    new_state = fused_update(state, batch)
+    return new_state, state.step  # graftlint: disable=GL017 (fixture: replay semantics, donation elided at runtime)
+
+
+def local_wrapper(state, batch):
+    # forwards `state` into fused_update's donated position (a cross-
+    # module fact the index fixpoint carries back here)
+    return fused_update(state, batch)
+
+
+def outer_jit():
+    return jax.jit(local_wrapper)  # GL017: drops local_wrapper's donation

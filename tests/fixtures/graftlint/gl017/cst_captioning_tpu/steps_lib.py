@@ -1,0 +1,28 @@
+"""GL017 cross-file fixture — the DONATING side.
+
+``fused_update`` donates its arg 0 when called (literal
+``donate_argnums`` decoration); ``make_step`` is the factory pattern —
+calling it RETURNS a donating jit. Callers in ``loop.py`` must treat a
+buffer passed through either as deleted — a fact no per-file engine can
+know from the caller alone.
+
+Deliberately lint-dirty directory: skipped by the repo-wide walk
+(``fixtures`` is in core._SKIP_DIRS), linted explicitly by the tests.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def fused_update(state, batch):
+    return state
+
+
+def _impl(state, batch):
+    return state
+
+
+def make_step():
+    return jax.jit(_impl, donate_argnums=(0,))

@@ -664,12 +664,18 @@ def test_cli_json_schema(tmp_path, capsys):
     (finding,) = report["findings"]
     assert set(finding) == {
         "rule", "severity", "path", "line", "col", "message", "context",
-        "baselined",
+        "baselined", "fix",
     }
     assert finding["rule"] == "GL001" and finding["line"] == 4
+    assert finding["fix"] is None  # GL001 has no mechanical repair
     # the two-pass engine's bookkeeping rides along in the report
     assert report["stale_baseline"] == []
     assert report["unused_suppressions"] == []
+    # the fixes block: autofixable counts + the stale classes --fix repairs
+    assert set(report["fixes"]) == {
+        "autofixable", "by_rule", "stale_suppressions", "stale_baseline",
+    }
+    assert report["fixes"]["autofixable"] == 0
     timings = report["timings"]
     assert {"index_seconds", "rules_seconds"} <= set(timings)
     assert timings["files"] == 1
@@ -694,7 +700,7 @@ def test_cli_list_rules_names_all_registered(tmp_path, capsys):
     out = capsys.readouterr().out
     for rid in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                 "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                "GL013", "GL014", "GL015"):
+                "GL013", "GL014", "GL015", "GL016", "GL017"):
         assert rid in out
 
 
@@ -1246,3 +1252,528 @@ def test_gl015_not_applied_in_tests(tmp_path):
         "S = P('i')\n"
     ), rules=["GL015"])
     assert findings == []
+
+
+# ---- GL016: collective over a declared-but-unbound axis ---------------------
+
+def test_gl016_cross_file_unbound_axis_in_shard_map_called_helper():
+    """THE acceptance fixture: 'pipeline' is a declared mesh axis (GL012
+    provably cannot flag it), but the only call path into the helper goes
+    through a shard_map body binding just 'model' (axis_names=) — the
+    axis-environment fixpoint sees that across files."""
+    findings = _lint_fixture("gl016", ["GL016"])
+    assert len(findings) == 1
+    (f,) = findings
+    assert f.rule == "GL016" and f.severity == "error"
+    assert f.path.endswith("collectives.py")
+    assert "'pipeline'" in f.message and "reduce_pipeline" in f.message
+    # the message names what the callers DO bind
+    assert "model" in f.message
+
+
+def test_gl016_gl012_provably_cannot_see_the_fixture():
+    """GL012's literal-vs-mesh check passes on the whole gl016 pair —
+    every axis spelled is either declared (pipeline/model) or visibly
+    bound (vmap's 'rollout'): only the scoped rule catches the bug."""
+    assert _lint_fixture("gl016", ["GL012"]) == []
+
+
+def test_gl016_single_file_engine_provably_cannot():
+    """Linting the helpers ALONE must find nothing: with no known caller
+    the runtime context is unknowable (and the binding lives in
+    mapper.py)."""
+    assert _lint_fixture(
+        "gl016", ["GL016"], only="cst_captioning_tpu/collectives.py"
+    ) == []
+
+
+def test_gl016_bound_axis_and_suppressed_twin_quiet():
+    findings = _lint_fixture("gl016", ["GL016"])
+    lines = {f.line for f in findings}
+    # reduce_model (bound via shard_map) and the suppressed twin are quiet
+    assert len(findings) == 1 and all(
+        "reduce_model" not in f.message for f in findings
+    )
+    assert lines != set()
+
+
+def test_gl012_vmap_bound_axis_not_a_typo():
+    """The GL016 substrate refines GL012: an axis bound by a reachable
+    vmap(axis_name=) is legitimate even though mesh.py never declares
+    it (mapper.py's 'rollout' lane axis)."""
+    findings = _lint_fixture("gl016", ["GL012"],
+                             only="cst_captioning_tpu/mapper.py")
+    assert findings == []
+
+
+def test_gl016_unbound_helper_called_from_plain_context(tmp_path):
+    """A helper with a literal mesh-axis collective whose only caller is
+    an ordinary function (no binding anywhere) IS a finding — that is
+    the runtime unbound-axis error."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def helper(x):\n"
+        "    return jax.lax.psum(x, 'data')\n"
+        "def epoch(xs):\n"
+        "    return [helper(x) for x in xs]\n"
+    ), rules=["GL016"])
+    assert _rules_of(findings) == ["GL016"]
+    assert findings[0].line == 3
+
+
+def test_gl016_shard_map_without_axis_names_binds_all_mesh_axes(tmp_path):
+    """A shard_map with no axis_names= literal binds every declared mesh
+    axis (the mesh argument is dynamic): collectives over any declared
+    axis under it stay quiet."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "def helper(x):\n"
+        "    return jax.lax.psum(x, 'seq')\n"
+        "def run(mesh, xs):\n"
+        "    def body(x):\n"
+        "        return helper(x)\n"
+        "    return shard_map(body, mesh=mesh, in_specs=None,\n"
+        "                     out_specs=None)(xs)\n"
+    ), rules=["GL016"])
+    assert findings == []
+
+
+# ---- GL017: interprocedural donation hazards --------------------------------
+
+def test_gl017_cross_file_donation_hazards():
+    """The acceptance trio: use-after-donate through the make_step
+    factory, the loop-carried un-rebound donation, and the outer jit()
+    that silently drops a wrapper's donation — all facts living in
+    steps_lib.py."""
+    findings = _lint_fixture("gl017", ["GL017"])
+    assert len(findings) == 3
+    assert all(f.path.endswith("loop.py") for f in findings)
+    factory, loop, wrapper = sorted(findings, key=lambda f: f.line)
+    assert factory.severity == "error"
+    assert "donated" in factory.message and "make_step" in factory.message
+    assert "fused_update" in loop.message
+    assert wrapper.severity == "warning"
+    assert "local_wrapper" in wrapper.message
+    assert "ignored" in wrapper.message
+
+
+def test_gl017_single_file_engine_provably_cannot():
+    assert _lint_fixture(
+        "gl017", ["GL017"], only="cst_captioning_tpu/loop.py"
+    ) == []
+
+
+def test_gl017_rebind_and_read_before_and_suppressed_quiet():
+    findings = _lint_fixture("gl017", ["GL017"])
+    for f in findings:
+        assert "good_rebind" not in f.context
+        assert "good_read_before" not in f.context
+    # the suppressed twin is the same shape as the factory positive
+    assert len(findings) == 3
+
+
+def test_gl017_local_jit_use_after_donate(tmp_path):
+    """Single-file form: a locally-built donating jit, buffer re-read
+    after the donating call."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def train(state, batch, impl):\n"
+        "    step = jax.jit(impl, donate_argnums=(0,))\n"
+        "    new_state = step(state, batch)\n"
+        "    return new_state, state.loss\n"
+    ), rules=["GL017"])
+    assert _rules_of(findings) == ["GL017"]
+    assert findings[0].line == 5
+
+
+def test_gl017_dynamic_donation_stays_out_of_scope(tmp_path):
+    """`donate_argnums=(0,) if donate else ()` is dynamic: no fact is
+    recorded, nothing fires (never guess) — the repo's steps.py
+    factories keep linting clean."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def make(impl, donate):\n"
+        "    return jax.jit(impl, donate_argnums=(0,) if donate else ())\n"
+        "def train(state, batch, impl, donate):\n"
+        "    step = make(impl, donate)\n"
+        "    new_state = step(state, batch)\n"
+        "    return new_state, state.loss\n"
+    ), rules=["GL017"])
+    assert findings == []
+
+
+def test_gl017_branch_exclusive_donation_no_false_positive(tmp_path):
+    """A donation in one `if` arm must not flag a read in the OTHER arm
+    (exclusive paths); a read AFTER the join on the donating path is
+    still caught via the may-join."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def train(state, batch, impl, fast):\n"
+        "    step = jax.jit(impl, donate_argnums=(0,))\n"
+        "    if fast:\n"
+        "        out = step(state, batch)\n"
+        "    else:\n"
+        "        out = state.replace(step=state.step + 1)\n"
+        "    return out\n"
+    ), rules=["GL017"])
+    assert findings == []
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def train(state, batch, impl, fast):\n"
+        "    step = jax.jit(impl, donate_argnums=(0,))\n"
+        "    if fast:\n"
+        "        out = step(state, batch)\n"
+        "    else:\n"
+        "        out = None\n"
+        "    return out, state.loss\n"
+    ), rules=["GL017"])
+    assert _rules_of(findings) == ["GL017"] and findings[0].line == 8
+
+
+def test_gl017_not_applied_in_tests(tmp_path):
+    findings = _lint(tmp_path, "tests/test_fake.py", (
+        "import jax\n"
+        "def test_donation_error(state, batch, impl):\n"
+        "    step = jax.jit(impl, donate_argnums=(0,))\n"
+        "    new_state = step(state, batch)\n"
+        "    return new_state, state.loss\n"
+    ), rules=["GL017"])
+    assert findings == []
+
+
+# ---- autofix engine ---------------------------------------------------------
+
+def _write_repo(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    (tmp_path / "graftlint.baseline").write_text(
+        json.dumps({"version": 1, "entries": []})
+    )
+
+
+_FIXABLE_GL013 = {
+    "cst_captioning_tpu/producer.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def encode(x):\n"
+        "    return jnp.tanh(x)\n"
+        "def decode(feats):\n"
+        "    return encode(feats) * 2\n"
+    ),
+    "cst_captioning_tpu/consumer.py": (
+        "import jax\n"
+        "import numpy as np\n"
+        "from cst_captioning_tpu.producer import decode\n"
+        "def to_host(feats):\n"
+        "    tokens = decode(feats)\n"
+        "    return np.asarray(tokens)\n"
+    ),
+}
+
+
+def test_fix_applies_and_is_idempotent(tmp_path, capsys):
+    """--fix rewrites np.asarray -> jax.device_get, the tree relints
+    clean, and a second --fix is a byte-for-byte no-op (the pinned
+    idempotence contract)."""
+    _write_repo(tmp_path, _FIXABLE_GL013)
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache"]
+    assert cli_main(args + ["--fix"]) == 0
+    capsys.readouterr()
+    fixed = (tmp_path / "cst_captioning_tpu/consumer.py").read_text()
+    assert "jax.device_get(tokens)" in fixed and "np.asarray" not in fixed
+    assert cli_main(args) == 0  # tree is lint-clean after the fix
+    before = fixed
+    assert cli_main(args + ["--fix"]) == 0
+    assert (tmp_path / "cst_captioning_tpu/consumer.py").read_text() == before
+
+
+def test_fix_dry_run_prints_diff_and_writes_nothing(tmp_path, capsys):
+    _write_repo(tmp_path, _FIXABLE_GL013)
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache", "--fix", "--dry-run"]
+    assert cli_main(args) == 0
+    out = capsys.readouterr()
+    assert "+    return jax.device_get(tokens)" in out.out
+    assert "-    return np.asarray(tokens)" in out.out
+    assert "would fix" in out.err
+    src = (tmp_path / "cst_captioning_tpu/consumer.py").read_text()
+    assert "np.asarray(tokens)" in src  # untouched
+
+
+def test_fix_check_gates_until_fixed(tmp_path, capsys):
+    """--fix-check is the CI spelling: exit 1 while an autofixable
+    finding is unfixed, 0 after --fix; it never writes."""
+    _write_repo(tmp_path, _FIXABLE_GL013)
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache"]
+    assert cli_main(args + ["--fix-check"]) == 1
+    err = capsys.readouterr().err
+    assert "autofixable" in err and "--fix" in err
+    src = (tmp_path / "cst_captioning_tpu/consumer.py").read_text()
+    assert "np.asarray(tokens)" in src
+    assert cli_main(args + ["--fix"]) == 0
+    capsys.readouterr()
+    assert cli_main(args + ["--fix-check"]) == 0
+
+
+def test_fix_and_fix_check_are_exclusive(tmp_path, capsys):
+    _write_repo(tmp_path, {})
+    assert cli_main([str(tmp_path), "--root", str(tmp_path), "--fix",
+                     "--fix-check"]) == 2
+    assert cli_main([str(tmp_path), "--root", str(tmp_path),
+                     "--dry-run"]) == 2
+
+
+def test_fix_removes_stale_suppressions_and_baseline(tmp_path, capsys):
+    """The two repair classes --check-stale only reports: a dead inline
+    disable= comment is removed (whole line when alone, trimmed when
+    sharing one) and a dead baseline entry is dropped from the file."""
+    _write_repo(tmp_path, {
+        "cst_captioning_tpu/mod.py": (
+            "def f(x):\n"
+            "    return x  # graftlint: disable=GL001 (long fixed)\n"
+            "def g(x):\n"
+            "    # graftlint: disable-next-line=GL003\n"
+            "    return x\n"
+        ),
+    })
+    (tmp_path / "graftlint.baseline").write_text(json.dumps({
+        "version": 1,
+        "entries": [{
+            "rule": "GL001", "path": "cst_captioning_tpu/mod.py",
+            "context": "return np.asarray(ghost)", "count": 1,
+            "reason": "the code site was fixed long ago",
+        }],
+    }))
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache"]
+    assert cli_main(args + ["--check-stale"]) == 1  # stale gates
+    capsys.readouterr()
+    assert cli_main(args + ["--fix"]) == 0
+    src = (tmp_path / "cst_captioning_tpu/mod.py").read_text()
+    assert "graftlint" not in src
+    assert "return x" in src  # the code lines survived
+    bl = json.loads((tmp_path / "graftlint.baseline").read_text())
+    assert bl["entries"] == []
+    capsys.readouterr()
+    assert cli_main(args + ["--check-stale"]) == 0  # now stale-clean
+
+
+def test_fix_trims_one_dead_id_from_shared_suppression(tmp_path, capsys):
+    """A comment disabling two rules where only one still fires keeps the
+    live id."""
+    _write_repo(tmp_path, {
+        "cst_captioning_tpu/train/mod.py": (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return np.asarray(x)  # graftlint: disable=GL001,GL003\n"
+        ),
+    })
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache"]
+    assert cli_main(args + ["--fix"]) == 0
+    src = (tmp_path / "cst_captioning_tpu/train/mod.py").read_text()
+    assert "disable=GL001" in src and "GL003" not in src
+
+
+def test_overlapping_edits_refused():
+    """Two fixes claiming the same span: the engine applies the first and
+    refuses the second — never merges."""
+    from cst_captioning_tpu.tools.graftlint.core import Edit
+    from cst_captioning_tpu.tools.graftlint.fixes import (
+        OverlappingEditsError,
+        apply_edits,
+        edits_overlap,
+    )
+
+    src = "a = np.asarray(x)\n"
+    e1 = Edit(line=1, col=4, end_line=1, end_col=14, replacement="jd")
+    e2 = Edit(line=1, col=4, end_line=1, end_col=14, replacement="other")
+    e3 = Edit(line=1, col=15, end_line=1, end_col=16, replacement="y")
+    with pytest.raises(OverlappingEditsError):
+        apply_edits(src, [e1, e2])
+    assert edits_overlap(src, [e1], [e2])
+    assert not edits_overlap(src, [e1], [e3])
+    assert apply_edits(src, [e1, e3]) == "a = jd(y)\n"
+
+
+def test_fix_gl011_carry_init_dtype(tmp_path, capsys):
+    _write_repo(tmp_path, {
+        "cst_captioning_tpu/mod.py": (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def outer(xs):\n"
+            "    def body(c, x):\n"
+            "        return (c + x).astype(jnp.bfloat16), x\n"
+            "    init = jnp.zeros((4,), jnp.float32)\n"
+            "    return jax.lax.scan(body, init, xs)\n"
+        ),
+    })
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache", "--fix"]
+    assert cli_main(args) == 0
+    src = (tmp_path / "cst_captioning_tpu/mod.py").read_text()
+    assert "init = jnp.zeros((4,), jnp.bfloat16)" in src
+
+
+def test_fix_gl005_routes_through_dtype_param(tmp_path, capsys):
+    _write_repo(tmp_path, {
+        "cst_captioning_tpu/models/mod.py": (
+            "import jax.numpy as jnp\n"
+            "def forward(x, dtype):\n"
+            "    bias = jnp.zeros((4,), jnp.float32)\n"
+            "    return x + bias\n"
+        ),
+    })
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache", "--fix"]
+    assert cli_main(args) == 0
+    src = (tmp_path / "cst_captioning_tpu/models/mod.py").read_text()
+    assert "bias = jnp.zeros((4,), dtype)" in src
+
+
+def test_fix_gl005_no_dtype_param_stays_manual(tmp_path, capsys):
+    """Without a dtype in scope there is no mechanical spelling: the
+    finding still gates, but --fix-check does not claim it."""
+    _write_repo(tmp_path, {
+        "cst_captioning_tpu/models/mod.py": (
+            "import jax.numpy as jnp\n"
+            "def forward(x):\n"
+            "    bias = jnp.zeros((4,), jnp.float32)\n"
+            "    return x + bias\n"
+        ),
+    })
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache"]
+    assert cli_main(args + ["--fix-check"]) == 1  # GL005 still gates...
+    err = capsys.readouterr().err
+    assert "autofixable" not in err  # ...but not as an unfixed autofix
+
+
+def test_fix_skips_baselined_findings(tmp_path, capsys):
+    """Baselined findings are intentional: --fix must not rewrite them."""
+    _write_repo(tmp_path, _FIXABLE_GL013)
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache"]
+    assert cli_main(args + ["--write-baseline"]) == 0
+    capsys.readouterr()
+    assert cli_main(args + ["--fix"]) == 0
+    src = (tmp_path / "cst_captioning_tpu/consumer.py").read_text()
+    assert "np.asarray(tokens)" in src  # untouched: grandfathered
+
+
+def test_json_fixes_block_counts_autofixable(tmp_path, capsys):
+    _write_repo(tmp_path, _FIXABLE_GL013)
+    rc = cli_main([str(tmp_path / "cst_captioning_tpu"), "--root",
+                   str(tmp_path), "--no-cache", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["fixes"]["autofixable"] == 1
+    assert report["fixes"]["by_rule"] == {"GL013": 1}
+    fixable = [f for f in report["findings"] if f["fix"]]
+    assert len(fixable) == 1
+    fix = fixable[0]["fix"]
+    assert "device_get" in fix["description"]
+    assert all(
+        set(e) == {"line", "col", "end_line", "end_col", "replacement"}
+        for e in fix["edits"]
+    )
+
+
+# ---- summary cache: v3 schema (axis + donation summaries) -------------------
+
+def test_cache_schema_bump_cold_starts_cleanly(tmp_path):
+    """A cache written by an OLDER schema version is discarded wholesale:
+    the build re-summarizes everything and still computes the new axis/
+    donation facts (no half-read of the old schema)."""
+    from cst_captioning_tpu.tools.graftlint import ProjectIndex
+
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def update(state, batch):\n"
+        "    return state\n"
+    )
+    cache = tmp_path / "cache.json"
+    cache.write_text(json.dumps({
+        "version": 2,  # the pre-axis/donation schema
+        "files": {"m.py": {"mtime": 0.0, "size": 0,
+                           "summary": {"bogus": "shape"}}},
+    }))
+    idx = ProjectIndex.build([str(mod)], str(tmp_path),
+                             cache_path=str(cache))
+    assert idx.stats.summarized == 1 and idx.stats.cached == 0
+    assert idx.functions["m.update"].donated_argnums == [0]
+    # the rewritten cache is v3 and round-trips the new fields
+    data = json.loads(cache.read_text())
+    assert data["version"] == 3
+    idx2 = ProjectIndex.build([str(mod)], str(tmp_path),
+                              cache_path=str(cache))
+    assert idx2.stats.cached == 1
+    assert idx2.functions["m.update"].donated_argnums == [0]
+
+
+def test_cache_round_trips_axis_and_donation_summaries(tmp_path):
+    """Warm-cache builds must serve the NEW summary fields (axis tables,
+    donation facts) identically to a cold build — the fields are part of
+    the cached schema, not recomputed."""
+    from cst_captioning_tpu.tools.graftlint import ProjectIndex
+
+    (tmp_path / "lib.py").write_text(
+        "import jax\n"
+        "def helper(x):\n"
+        "    return jax.lax.psum(x, 'data')\n"
+        "def make_step(impl):\n"
+        "    return jax.jit(impl, donate_argnums=(1,))\n"
+    )
+    (tmp_path / "use.py").write_text(
+        "import jax\n"
+        "from lib import helper\n"
+        "def run(xs):\n"
+        "    return jax.vmap(helper, axis_name='data')(xs)\n"
+    )
+    files = [str(tmp_path / "lib.py"), str(tmp_path / "use.py")]
+    cache = tmp_path / "cache.json"
+    cold = ProjectIndex.build(files, str(tmp_path), cache_path=str(cache))
+    warm = ProjectIndex.build(files, str(tmp_path), cache_path=str(cache))
+    assert warm.stats.cached == 2 and warm.stats.summarized == 0
+    for idx in (cold, warm):
+        assert idx.functions["lib.make_step"].returns_donating == [1]
+        env, has_ctx = idx.axis_env_of("lib", "helper")
+        assert has_ctx and "data" in env
+        info = idx.modules["lib"].axis_funcs["helper"]
+        assert info.collectives == [("psum", "data", 3, 11)]
+
+
+def test_axis_env_transitive_through_helper_chain(tmp_path):
+    """Axis environments propagate through ordinary call edges: bound
+    body -> helper -> leaf, the leaf inherits the binding two hops up."""
+    from cst_captioning_tpu.tools.graftlint import ProjectIndex
+
+    (tmp_path / "m.py").write_text(
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "def leaf(x):\n"
+        "    return jax.lax.psum(x, 'data')\n"
+        "def mid(x):\n"
+        "    return leaf(x)\n"
+        "def run(mesh, xs):\n"
+        "    def body(x):\n"
+        "        return mid(x)\n"
+        "    return shard_map(body, mesh=mesh, in_specs=None,\n"
+        "                     out_specs=None, axis_names=('data',))(xs)\n"
+    )
+    idx = ProjectIndex.build([str(tmp_path / "m.py")], str(tmp_path),
+                             cache_path="")
+    for qual in ("leaf", "mid", "run.body"):
+        env, has_ctx = idx.axis_env_of("m", qual)
+        assert has_ctx and env == frozenset({"data"}), qual

@@ -142,3 +142,114 @@ def test_idf_zero_for_ubiquitous_ngrams():
     res = {f"v{i}": [toks("a b c d")] for i in range(5)}
     mean, _ = CiderD().compute_score(gts, res)
     np.testing.assert_allclose(mean, 0.0, atol=1e-12)
+
+
+# ---- native (C++ merge-join kernel) vs Python CiderD parity -----------------
+#
+# CaptionScorer defaults use_native=True, so eval/validation CIDEr-D — the
+# best-checkpoint selection signal — routes through the string-interning /
+# df-upload adapter in metrics/native_cider.py by default. These tests pin
+# the adapter against the Python oracle; the kernel accumulates per-id
+# scores in float32 (documented at NativeCiderD.compute_score), hence the
+# ~1e-8 relative tolerance rather than exact equality.
+
+_NATIVE_TOL = dict(rtol=1e-6, atol=1e-7)  # f32 kernel accumulation
+
+
+def _native(gts, df):
+    from cst_captioning_tpu.metrics.native_cider import NativeCiderD
+
+    n = NativeCiderD.build(gts, df)
+    if n is None:
+        pytest.skip("native creward library unavailable on this host")
+    return n
+
+
+def _parity_case():
+    gts = {
+        "v1": [toks("a man rides a horse"), toks("a person rides a horse")],
+        "v2": [toks("a cat sits on a mat")],
+        "v3": [toks("two dogs play in the park")],
+    }
+    res = {
+        "v1": [toks("a man rides a horse")],
+        "v2": [toks("a cat sits")],
+        "v3": [toks("dogs play fetch")],
+    }
+    return gts, res
+
+
+@pytest.mark.parametrize("mode", ["corpus", "corpus_df"])
+def test_native_ciderd_matches_python_oracle(mode):
+    """Both df modes: df='corpus' (eval semantics — df over the pools
+    being scored) and a precomputed CorpusDF forwarded as-is."""
+    gts, res = _parity_case()
+    if mode == "corpus":
+        df = "corpus"
+    else:
+        df = CorpusDF.from_refs(list(gts.values()))
+    native = _native(gts, df)
+    got = native.compute_score(res)
+    assert got is not None
+    mean_n, per_n = got
+    mean_p, per_p = CiderD(df=df).compute_score(gts, res)
+    np.testing.assert_allclose(per_n, per_p, **_NATIVE_TOL)
+    np.testing.assert_allclose(mean_n, mean_p, **_NATIVE_TOL)
+
+
+def test_native_ciderd_oov_hypothesis_words():
+    """Hypothesis words never seen in any reference intern to fresh ids;
+    they must contribute zero matches, exactly like the Python scorer
+    (and not crash the kernel's merge join)."""
+    gts = {
+        "v1": [toks("a man rides a horse")],
+        "v2": [toks("a cat sits on a mat")],
+    }
+    res = {
+        "v1": [toks("a man rides a zeppelin wombat")],  # OOV tail
+        "v2": [toks("qq ww ee rr")],                     # fully OOV
+    }
+    native = _native(gts, "corpus")
+    got = native.compute_score(res)
+    assert got is not None
+    mean_n, per_n = got
+    mean_p, per_p = CiderD(df="corpus").compute_score(gts, res)
+    np.testing.assert_allclose(per_n, per_p, **_NATIVE_TOL)
+    np.testing.assert_allclose(per_n[1], 0.0, atol=1e-7)
+    np.testing.assert_allclose(mean_n, mean_p, **_NATIVE_TOL)
+
+
+def test_native_ciderd_id_mismatch_falls_back_to_none():
+    """compute_score refuses a res pool it was not prepared for (the
+    df='corpus' semantics depend on the id set): the scorer then uses the
+    Python oracle. Both the subset and superset directions refuse."""
+    gts, res = _parity_case()
+    native = _native(gts, "corpus")
+    subset = {"v1": res["v1"]}
+    assert native.compute_score(subset) is None
+    superset = dict(res, v9=[toks("new clip")])
+    assert native.compute_score(superset) is None
+    # covers() is the scorer's cache-reuse predicate: exact pool only
+    assert native.covers(gts)
+    assert not native.covers({"v1": gts["v1"]})
+    # and the prepared pool still scores after the refusals
+    assert native.compute_score(res) is not None
+
+
+def test_native_ciderd_f32_tolerance_is_tight():
+    """The documented kernel contract: per-id divergence from the Python
+    (float64) oracle stays at f32 accumulation scale (~1e-8 relative for
+    O(10) scores) — if this drifts, best-checkpoint selection could flip
+    between the native and fallback paths."""
+    gts = {f"v{i}": [toks(f"w{i} x{i} y{i} z{i} common")]
+           for i in range(8)}
+    res = {f"v{i}": [toks(f"w{i} x{i} y{i} z{i} common")]
+           for i in range(8)}
+    native = _native(gts, "corpus")
+    got = native.compute_score(res)
+    assert got is not None
+    _, per_n = got
+    _, per_p = CiderD(df="corpus").compute_score(gts, res)
+    # identical hyp/ref: scores are O(10); 1e-6 absolute ≈ 1e-7 relative
+    np.testing.assert_allclose(per_n, per_p, rtol=0, atol=1e-5)
+    assert np.max(np.abs(per_n - per_p)) < 1e-5
