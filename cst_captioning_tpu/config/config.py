@@ -204,6 +204,16 @@ class TrainConfig:
                 f"health_sim_hosts {self.health_sim_hosts} must be >= 0 "
                 "(0 = the real process count)"
             )
+        if self.comm_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"unknown comm_dtype {self.comm_dtype!r} "
+                "(expected 'f32' or 'bf16')"
+            )
+        if self.comm_bucket_mb < 0:
+            raise ValueError(
+                f"comm_bucket_mb {self.comm_bucket_mb} must be >= 0 "
+                "(0 = one message per leaf)"
+            )
     # per-step JSONL events (loss/reward + grad_norm every N steps; 0 = off,
     # keeping logs to per-epoch summaries)
     log_every_steps: int = 0
@@ -253,6 +263,21 @@ class TrainConfig:
     # a cross-host collective slower than this emits a dcn_stall event +
     # counter (the DCN-stall span around the multihost barrier/broadcast)
     dcn_stall_s: float = 2.0
+    # ---- gradient communication (parallel/comms.py; README "Gradient
+    # communication"): how the data-parallel factories allreduce grads.
+    # Target payload per collective in MiB — the grad tree coalesces into
+    # family-ordered contiguous buckets of at most this many WIRE bytes and
+    # one psum runs per bucket (0 = one psum per leaf). Bit-identical to the
+    # per-leaf spelling at f32 — psum is elementwise
+    comm_bucket_mb: float = 4.0
+    # "f32" (bit-exact default) | "bf16": grads ride the wire in bfloat16,
+    # halving bytes; params/optimizer moments stay f32 (master accumulation)
+    comm_dtype: str = "f32"
+    # overlap the grad reduction with the backward scan: each rl.update_chunks
+    # chunk's psum starts while the next chunk's backward runs (double-
+    # buffered carry). Needs rl.update_chunks >= 2; trades (chunks+1)x wire
+    # bytes for latency hiding — see the README section before enabling
+    comm_overlap: bool = False
 
 
 @dataclass(frozen=True)
@@ -368,6 +393,26 @@ class ExperimentConfig:
             raise ValueError(
                 f"rl.reward_threads {self.rl.reward_threads} must be >= 0 "
                 "(0 = all cores)"
+            )
+        if self.train.comm_overlap and self.rl.update_chunks < 2:
+            # overlap hides the psum behind the NEXT chunk's backward — with
+            # one chunk there is nothing to hide behind
+            raise ValueError(
+                "train.comm_overlap requires rl.update_chunks >= 2 (the "
+                f"chunk boundary is the overlap seam; got "
+                f"{self.rl.update_chunks})"
+            )
+        if self.mesh.seq_devices > 1 and (
+            self.train.comm_dtype != "f32" or self.train.comm_overlap
+        ):
+            # the SP factories take grads OUTSIDE shard_map (the collective
+            # transposes already produce global grads) — there is no grad
+            # allreduce to compress or overlap
+            raise ValueError(
+                "train.comm_dtype='bf16' / train.comm_overlap are not "
+                "implemented for the sequence-parallel ('seq_devices > 1') "
+                "path: its gradients are computed outside shard_map and "
+                "never ride a grad allreduce"
             )
 
     # ---- serialization ----------------------------------------------------

@@ -7,6 +7,15 @@ lives frame-sharded across the mesh and the only frame-crossing reductions
 (attention softmax, pooled carry init) run as XLA collectives over ICI.
 """
 
+from cst_captioning_tpu.parallel.comms import (
+    Bucket,
+    BucketPlan,
+    CommConfig,
+    ledger,
+    per_leaf_f32_bytes,
+    plan_buckets,
+    reduce_tree,
+)
 from cst_captioning_tpu.parallel.seq_parallel import (
     make_sp_decode,
     make_sp_forward,
@@ -18,7 +27,14 @@ from cst_captioning_tpu.parallel.seq_parallel import (
 )
 
 __all__ = [
+    "Bucket",
+    "BucketPlan",
+    "CommConfig",
+    "ledger",
     "make_sp_decode",
+    "per_leaf_f32_bytes",
+    "plan_buckets",
+    "reduce_tree",
     "make_sp_forward",
     "make_sp_rl_update",
     "make_sp_xe_step",

@@ -159,13 +159,20 @@ def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
 def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
                     label_smoothing: float = 0.0, data_axis: str = "",
                     seq_axis: str = "seq", donate: bool = False,
-                    guard: bool = False) -> Callable:
+                    guard: bool = False, comm=None) -> Callable:
     """Jitted SP (optionally DP x SP) XE train step.
 
     The loss is computed inside shard_map (loss psum'd over ``data_axis``
     when sharded); ``value_and_grad`` wraps the WHOLE sharded computation, so
     the collective transposes produce exact global gradients.
+
+    ``comm`` (parallel/comms.CommConfig) is accepted for factory-signature
+    symmetry and IGNORED: gradients here are taken outside shard_map — the
+    collective transposes already yield global grads, so there is no grad
+    allreduce to bucket, compress, or overlap (ExperimentConfig rejects
+    bf16/overlap knobs on the seq-parallel path for the same reason).
     """
+    del comm  # no grad allreduce on this path — see docstring
     f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
     b = data_axis if data_axis else None
 
@@ -216,7 +223,8 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
 
 def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
                       seq_axis: str = "seq", chunks: int = 1,
-                      donate: bool = False, guard: bool = False) -> Callable:
+                      donate: bool = False, guard: bool = False,
+                      comm=None) -> Callable:
     """Jitted DP x SP REINFORCE update (the SCST update on a 2-D mesh).
 
     Same structure as :func:`make_sp_xe_step`: the (numerator, denominator)
@@ -229,7 +237,12 @@ def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
     accumulated, normalized once by the global token count — producing the
     same total gradient in K/chunks of the activation memory (the same
     HBM-ceiling lever as ``rl.update_chunks`` on the 1-D mesh).
+
+    ``comm`` is accepted for factory-signature symmetry and IGNORED — same
+    reason as :func:`make_sp_xe_step`: grads are taken outside shard_map,
+    there is no grad allreduce to shape.
     """
+    del comm  # no grad allreduce on this path — see docstring
     from cst_captioning_tpu.models.captioner import EncoderOutput
 
     f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)

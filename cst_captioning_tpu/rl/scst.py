@@ -40,7 +40,9 @@ from cst_captioning_tpu.decoding.common import _exit_stride, mask_from_tokens
 from cst_captioning_tpu.obs import flops as _flops
 from cst_captioning_tpu.losses import reinforce_loss, sequence_log_probs
 from cst_captioning_tpu.models.captioner import CaptionModel
+from cst_captioning_tpu.parallel.comms import reduce_tree
 from cst_captioning_tpu.resilience import chaos
+from cst_captioning_tpu.resilience.health import collective_span
 from cst_captioning_tpu.resilience.retry import RetryPolicy, retry_call
 from cst_captioning_tpu.rl.rewards import RewardComputer, scb_baseline
 from cst_captioning_tpu.train.state import TrainState
@@ -219,7 +221,8 @@ def _decode_loss_sums(model, params, enc_tiled, tokens_flat, advantage_flat,
 
 
 def _chunked_loss_grads(model, params, feats, masks, samples, advantage,
-                        valid, chunks: int, vary_axis: str | None = None):
+                        valid, chunks: int, vary_axis: str | None = None,
+                        comm=None):
     """REINFORCE loss sums + gradients, accumulated over ``chunks`` slices
     of the K rollout axis — with ONE encoder pass shared by every chunk.
 
@@ -231,6 +234,30 @@ def _chunked_loss_grads(model, params, feats, masks, samples, advantage,
     accumulate in f32 across chunks, and one ``enc_vjp`` call at the end
     folds them into the parameter gradients. Same total gradient as the
     feature-tiled computation up to float summation order.
+
+    ``comm`` (parallel/comms.CommConfig) with ``overlap != "off"`` moves the
+    cross-device grad allreduce INSIDE the scan (needs ``vary_axis``): each
+    chunk's parameter grads are reduced per chunk instead of accumulate-
+    then-reduce, so the collective can run while the next chunk's backward
+    computes. Two spellings, bit-identical to each other at f32:
+
+    - ``"defer"`` — the production overlap: a double-buffered carry holds
+      the PREVIOUS chunk's unreduced grads; iteration *i* issues the psum
+      of chunk *i-1*'s grads alongside chunk *i*'s forward+backward, giving
+      the scheduler a full chunk of compute to hide each collective behind
+      (one flush reduction after the scan drains the buffer).
+    - ``"eager"`` — reduce each chunk's grads in its own iteration; no
+      buffering, nothing to overlap. Float-order-identical to "defer"
+      (defer merely adds a leading ``+ psum(zeros)``, a bitwise no-op), so
+      it serves as its bit-exact parity reference in tests/bench.
+
+    When overlap is active the returned gradients are ALREADY reduced over
+    ``vary_axis`` (axis-invariant); the caller must not psum them again —
+    only the scalar num/den sums still need their reduction. Note the
+    per-chunk reductions move (chunks+1)x the payload of the single fused
+    reduction (each chunk reduces a full params-shaped tree, plus the
+    encoder-cotangent fold at the end) — that is the latency-for-bandwidth
+    trade, ledgered honestly by bench_comms.py.
     """
 
     K, B, T = samples.shape
@@ -262,46 +289,98 @@ def _chunked_loss_grads(model, params, feats, masks, samples, advantage,
             model, p, _tile_enc(e, kc), tokens, a, valid_f
         )
 
-    def body(acc, x):
-        gp_acc, ge_acc, num_acc, den_acc = acc
-        (num, den), (gp, ge) = jax.value_and_grad(
-            sums_fn, argnums=(0, 1), has_aux=True
-        )(params, enc, *x)
-        return (
-            jax.tree.map(jnp.add, gp_acc, gp),
-            # f32 accumulation: the cotangents arrive in the model dtype
-            # (bf16 on the flagship config) and 8 mantissa bits across
-            # `chunks` additions is avoidable error
-            jax.tree.map(lambda a_, g: a_ + g.astype(a_.dtype), ge_acc, ge),
-            num_acc + num,
-            den_acc + den,
-        ), None
+    overlap = comm is not None and comm.overlap != "off"
+    if overlap and vary_axis is None:
+        raise ValueError(
+            "comm overlap needs vary_axis (the per-chunk reduction runs "
+            "inside shard_map); single-device updates have nothing to "
+            "overlap"
+        )
 
-    init = (
-        jax.tree.map(jnp.zeros_like, params),
-        jax.tree.map(
-            lambda x: jnp.zeros(x.shape, jnp.promote_types(x.dtype, jnp.float32)),
-            enc,
-        ),
-        jnp.zeros(()),
-        jnp.zeros(()),
+    def chunk_grads(x):
+        return jax.value_and_grad(sums_fn, argnums=(0, 1), has_aux=True)(
+            params, enc, *x
+        )
+
+    def accum_ge(ge_acc, ge):
+        # f32 accumulation: the cotangents arrive in the model dtype
+        # (bf16 on the flagship config) and 8 mantissa bits across
+        # `chunks` additions is avoidable error
+        return jax.tree.map(lambda a_, g: a_ + g.astype(a_.dtype), ge_acc, ge)
+
+    zeros_p = jax.tree.map(jnp.zeros_like, params)
+    zeros_e = jax.tree.map(
+        lambda x: jnp.zeros(x.shape, jnp.promote_types(x.dtype, jnp.float32)),
+        enc,
     )
     if vary_axis is not None:
         # inside shard_map the per-chunk grads/sums vary over the batch
         # axis; the scan carry init must carry the same varying-axis type
-        init = jax.tree.map(
-            lambda x: pcast(x, vary_axis, to="varying"), init
+        vary = lambda t: jax.tree.map(
+            lambda x: pcast(x, vary_axis, to="varying"), t
         )
-    (gp, ge, num, den), _ = jax.lax.scan(body, init, (sam, adv))
+    else:
+        vary = lambda t: t
+
+    if overlap:
+        # gp_acc accumulates the REDUCED (axis-invariant) per-chunk grads;
+        # gp_pend is the double buffer holding the previous chunk's
+        # unreduced (varying) grads, drained one iteration late so its
+        # psum can fly while this iteration's backward computes
+        def body(acc, x):
+            gp_acc, gp_pend, ge_acc, num_acc, den_acc = acc
+            if comm.overlap == "defer":
+                gp_acc = jax.tree.map(
+                    jnp.add, gp_acc, reduce_tree(gp_pend, vary_axis, comm)
+                )
+            (num, den), (gp, ge) = chunk_grads(x)
+            if comm.overlap == "eager":
+                gp_acc = jax.tree.map(
+                    jnp.add, gp_acc, reduce_tree(gp, vary_axis, comm)
+                )
+                gp = gp_pend  # buffer unused: stays the zeros it came in as
+            return (
+                gp_acc, gp, accum_ge(ge_acc, ge),
+                num_acc + num, den_acc + den,
+            ), None
+
+        init = (
+            zeros_p, vary(zeros_p), vary(zeros_e),
+            vary(jnp.zeros(())), vary(jnp.zeros(())),
+        )
+        (gp, gp_pend, ge, num, den), _ = jax.lax.scan(body, init, (sam, adv))
+        if comm.overlap == "defer":
+            # flush: the last chunk's grads are still in the buffer ("defer"
+            # is bit-equal to "eager" — its extra leading `+ psum(zeros)`
+            # adds +0.0, a bitwise no-op)
+            gp = jax.tree.map(
+                jnp.add, gp, reduce_tree(gp_pend, vary_axis, comm)
+            )
+    else:
+        def body(acc, x):
+            gp_acc, ge_acc, num_acc, den_acc = acc
+            (num, den), (gp, ge) = chunk_grads(x)
+            return (
+                jax.tree.map(jnp.add, gp_acc, gp), accum_ge(ge_acc, ge),
+                num_acc + num, den_acc + den,
+            ), None
+
+        init = vary((zeros_p, zeros_e, jnp.zeros(()), jnp.zeros(())))
+        (gp, ge, num, den), _ = jax.lax.scan(body, init, (sam, adv))
+
     # vjp cotangents must match the primal dtype
     ge = jax.tree.map(lambda g, x: g.astype(x.dtype), ge, enc)
     (g_enc,) = enc_vjp(ge)
+    if overlap:
+        # keep the already-reduced invariant: fold the encoder grads in
+        # reduced too, so the caller skips its own grad psum entirely
+        g_enc = reduce_tree(g_enc, vary_axis, comm)
     g_sum = jax.tree.map(jnp.add, gp, g_enc)
     return num, den, g_sum
 
 
 def make_rl_update(model, chunks: int = 1, donate: bool = False,
-                   guard: bool = False) -> Callable:
+                   guard: bool = False, comm=None) -> Callable:
     """Jitted: (state, feats, masks, samples [K,B,T], adv [K,B]) -> (state, metrics).
 
     ``chunks > 1`` accumulates gradients over slices of the rollout axis
@@ -311,8 +390,10 @@ def make_rl_update(model, chunks: int = 1, donate: bool = False,
     consumed — rebind, never reuse); off by default so exactness tests can
     replay one state through several update variants. ``guard=True``
     suppresses non-finite updates on device (resilience/guard.py) and adds
-    a ``nonfinite`` metric.
+    a ``nonfinite`` metric. ``comm`` (parallel/comms.CommConfig) is accepted
+    for factory-signature symmetry and ignored: no collectives here.
     """
+    del comm  # no cross-device reduction on this path
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def update(state: TrainState, feats, masks, samples, advantage, valid):
@@ -348,15 +429,30 @@ def make_rl_update(model, chunks: int = 1, donate: bool = False,
 
 def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
                             chunks: int = 1, donate: bool = False,
-                            guard: bool = False) -> Callable:
+                            guard: bool = False, comm=None) -> Callable:
     """shard_map variant: batch axis sharded, exact global normalization.
-    ``chunks`` / ``donate`` / ``guard`` exactly like :func:`make_rl_update`."""
+    ``chunks`` / ``donate`` / ``guard`` exactly like :func:`make_rl_update`.
+
+    ``comm`` (parallel/comms.CommConfig) selects the grad-allreduce
+    spelling: None keeps the original per-leaf psum; otherwise bucketed
+    (and optionally bf16) reduction, and with ``comm.overlap != "off"`` the
+    per-chunk reduction runs inside the update scan so it can hide behind
+    the next chunk's backward (see :func:`_chunked_loss_grads` — the
+    chunked path then returns already-reduced grads).
+    """
+    overlap = comm is not None and comm.overlap != "off"
+    if overlap and chunks < 2:
+        raise ValueError(
+            "comm overlap requires chunks >= 2: the rl.update_chunks "
+            "boundary is the overlap seam (config validation enforces the "
+            f"same; got chunks={chunks})"
+        )
 
     def device_update(state, feats, masks, samples, advantage, valid):
         if chunks > 1:
             num, den, grads_num = _chunked_loss_grads(
                 model, state.params, feats, masks, samples, advantage, valid,
-                chunks, vary_axis=axis,
+                chunks, vary_axis=axis, comm=comm,
             )
         else:
 
@@ -376,9 +472,12 @@ def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
             )(state.params)
         den_total = jax.lax.psum(den, axis)
         loss = jax.lax.psum(num, axis) / jnp.maximum(den_total, 1.0)
+        if not overlap:
+            # the chunked-overlap path hands back already-reduced grads;
+            # everything else reduces here, after the full local backward
+            grads_num = reduce_tree(grads_num, axis, comm)
         grads = jax.tree.map(
-            lambda g: jax.lax.psum(g, axis) / jnp.maximum(den_total, 1.0),
-            grads_num,
+            lambda g: g / jnp.maximum(den_total, 1.0), grads_num
         )
         gnorm = optax.global_norm(grads)
         # psum'd grads/loss are device-invariant: the guarded select picks
@@ -419,6 +518,7 @@ class SCSTTrainer:
         guard: bool = False,
         retry: RetryPolicy | None = None,
         on_event: Callable | None = None,
+        comm=None,
     ):
         """``donate=True`` makes the REINFORCE update consume its input state
         (buffer donation — see :func:`make_rl_update`); the production
@@ -426,11 +526,15 @@ class SCSTTrainer:
         ``guard=True`` adds the on-device non-finite update guard.
         ``retry`` is the backoff policy for the (host-side, fallible in
         production) reward scorer; ``on_event(event, **fields)`` receives
-        ``reward_retry`` events (an EventLogger.log works as-is)."""
+        ``reward_retry`` events (an EventLogger.log works as-is).
+        ``comm`` (parallel/comms.CommConfig) selects the update's grad
+        allreduce spelling (None = original per-leaf psum); the Trainer
+        builds it from the ``train.comm_*`` knobs."""
         self.model = model
         self.reward = reward
         self.cfg = cfg
         self.mesh = mesh
+        self.comm = comm
         self.retry = retry or RetryPolicy()
         self.on_event = on_event or (lambda event, **fields: None)
         # analytic per-clip FLOPs (obs/flops.py) for the run report's MFU
@@ -463,6 +567,11 @@ class SCSTTrainer:
         self._update_flops_per_clip = _flops.update_flops_per_clip(
             K=cfg.num_rollouts, T=self._depth_budget, **dims,
         )
+        # compile-time update cost (obs/flops.compiled_cost), resolved
+        # lazily at the first dispatch when obs is on: None = not yet
+        # probed, False = XLA exposed no cost (analytic fallback), float =
+        # whole-update FLOPs from the compiled program
+        self._update_cost = None
         obs.gauge("rl.decode.budget").set(float(self._depth_budget))
         # only the 'greedy' baseline consumes the greedy rollout: scb/none
         # skip its decode, host transfer, and reward scoring entirely (one
@@ -482,7 +591,7 @@ class SCSTTrainer:
             )
             self.update = make_sp_rl_update(
                 spm, mesh, chunks=cfg.update_chunks, donate=donate,
-                guard=guard,
+                guard=guard, comm=comm,
             )
         elif mesh is not None:
             self.decode = make_parallel_rl_decode(
@@ -491,7 +600,7 @@ class SCSTTrainer:
             )
             self.update = make_parallel_rl_update(
                 model, mesh, chunks=cfg.update_chunks, donate=donate,
-                guard=guard,
+                guard=guard, comm=comm,
             )
         else:
             self.decode = make_rl_decode(
@@ -499,7 +608,8 @@ class SCSTTrainer:
                 with_greedy=wg,
             )
             self.update = make_rl_update(
-                model, chunks=cfg.update_chunks, donate=donate, guard=guard
+                model, chunks=cfg.update_chunks, donate=donate, guard=guard,
+                comm=comm,
             )
 
     # ---- reward / advantage (host) ------------------------------------------
@@ -624,18 +734,30 @@ class SCSTTrainer:
             stats["lanes_skipped"]
         )
 
+    def _update_flops_inc(self, n_rows, args) -> float:
+        """Per-process FLOPs to count for one update dispatch. Prefers the
+        COMPILED program's own cost (obs/flops.compiled_cost — the number
+        bench_comms.py ledgers, so ``cli.obs_report`` MFU and the bench
+        agree); falls back to the analytic per-clip model when XLA exposes
+        no cost or obs is off (probing forces a lower+compile walk — free
+        on the hot path only because the jit cache already holds this
+        program, so don't pay it when nothing reads the counter). Either
+        way the per-process streams sum to the global total: the compiled
+        number is the whole (global-batch) program split evenly across
+        processes; the analytic one is counted over this host's rows."""
+        if self._update_cost is None and obs.enabled():
+            cost = _flops.compiled_cost(self.update, *args)
+            self._update_cost = cost["flops"] if cost else False
+        if self._update_cost:
+            return self._update_cost / jax.process_count()
+        return n_rows * self._update_flops_per_clip
+
     def _apply(self, state, advantage, host_metrics, samples, feats, masks,
                valid_np):
         """Device half: upload the advantage, dispatch the REINFORCE update."""
         from cst_captioning_tpu.train import multihost
 
-        # host time only: the update is dispatched, never waited on here.
-        # FLOPs are counted over THIS process's rows (valid_np is host-local)
-        # so per-process obs streams sum to the global total, matching the
-        # decode counter's to_host_local convention
-        obs.counter("flops.rl.update").inc(
-            len(valid_np) * self._update_flops_per_clip
-        )
+        # host time only: the update is dispatched, never waited on here
         with obs.span("rl.update"):
             # host numpy goes straight to its TARGET sharding (explicit
             # placement): converting to a single-device jnp array first
@@ -649,9 +771,18 @@ class SCSTTrainer:
             else:
                 adv = jnp.asarray(adv, jnp.float32)
                 valid = jnp.asarray(valid)
-            state, metrics = self.update(
-                state, feats, masks, samples, adv, valid
+            args = (state, feats, masks, samples, adv, valid)
+            obs.counter("flops.rl.update").inc(
+                self._update_flops_inc(len(valid_np), args)
             )
+            if self.mesh is not None and self.comm is not None:
+                # the update carries the grad allreduce: ledger its dispatch
+                # under the DCN/ICI collective span (PR 6 machinery) so
+                # stalls surface in the same place multihost barriers do
+                with collective_span("rl.update.allreduce"):
+                    state, metrics = self.update(*args)
+            else:
+                state, metrics = self.update(*args)
         metrics = dict(metrics)
         metrics.update(host_metrics)
         return state, metrics

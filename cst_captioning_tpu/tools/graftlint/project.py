@@ -707,6 +707,75 @@ def scan_axis_info(
     funcs: dict[str, AxisFuncInfo] = {}
     bindings: list[AxisBinding] = []
 
+    def stored_names(func: ast.AST) -> set:
+        """Names assigned anywhere in THIS def's body (nested defs have
+        their own scope and are skipped) — a rebind makes a string-default
+        axis parameter unresolvable, so it must drop out of the env."""
+        out: set = set()
+        work = list(ast.iter_child_nodes(func))
+        while work:
+            n = work.pop()
+            if isinstance(n, _FUNC_NODES) or isinstance(n, ast.ClassDef):
+                continue
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                out.add(n.id)
+            work.extend(ast.iter_child_nodes(n))
+        return out
+
+    def func_env(node: ast.AST, env: dict) -> dict:
+        """Axis environment for one def: inherited name -> axis-string
+        entries (closure capture), minus every name this def's parameters
+        or assignments shadow, plus this def's own ``axis``-suffixed
+        parameters with NON-EMPTY string defaults (the ``axis="data"``
+        factory spelling; the empty-string default means "no data axis"
+        in the SP factories and resolves to nothing)."""
+        args = node.args
+        all_args = args.posonlyargs + args.args + args.kwonlyargs + [
+            a for a in (args.vararg, args.kwarg) if a is not None
+        ]
+        stores = stored_names(node)
+        shadowed = {a.arg for a in all_args} | stores
+        child = {k: v for k, v in env.items() if k not in shadowed}
+        pos = args.posonlyargs + args.args
+        pairs = list(
+            zip(pos[len(pos) - len(args.defaults):], args.defaults)
+        ) + [
+            (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+            if d is not None
+        ]
+        for arg, default in pairs:
+            if (
+                arg.arg.endswith("axis")
+                and arg.arg not in stores
+                and isinstance(default, ast.Constant)
+                and isinstance(default.value, str)
+                and default.value
+            ):
+                child[arg.arg] = default.value
+        return child
+
+    def axis_values(arg, env: dict) -> tuple:
+        """Axis strings an axis argument resolves to: literals as before,
+        plus bare names (or tuple/list elements) that resolve through the
+        string-default parameter env; anything else resolves to ()."""
+        lit = _literal_str_tuple(arg)
+        if lit is not None:
+            return lit
+        if isinstance(arg, ast.Name):
+            val = env.get(arg.id)
+            return (val,) if val else ()
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            out = []
+            for e in arg.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append(e.value)
+                elif isinstance(e, ast.Name) and env.get(e.id):
+                    out.append(env[e.id])
+                else:
+                    return ()  # one opaque element -> the whole arg is
+            return tuple(out)
+        return ()
+
     def binder_axes(call: ast.Call):
         """-> tuple of axes, None (= all mesh axes), or False (no named
         binding here)."""
@@ -727,7 +796,7 @@ def scan_axis_info(
                     return axes
         return False
 
-    def handle_call(call: ast.Call, owner: str) -> None:
+    def handle_call(call: ast.Call, owner: str, env: dict) -> None:
         name = _last(_dotted(call.func))
         pos = COLLECTIVE_AXIS_POS.get(name)
         if pos is not None and owner:
@@ -737,7 +806,7 @@ def scan_axis_info(
                     axis_arg = kw.value
             if axis_arg is None and len(call.args) > pos:
                 axis_arg = call.args[pos]
-            for ax in _literal_str_tuple(axis_arg) or ():
+            for ax in axis_values(axis_arg, env):
                 funcs[owner].collectives.append(
                     (name, ax, call.lineno, call.col_offset)
                 )
@@ -759,22 +828,22 @@ def scan_axis_info(
 
     # explicit stack (not recursion): this walk visits every node of every
     # module on a cold run — call overhead is the budget's margin
-    stack: list[tuple[ast.AST, str, str]] = [(tree, "", "")]
+    stack: list[tuple[ast.AST, str, str, dict]] = [(tree, "", "", {})]
     while stack:
-        node, owner, prefix = stack.pop()
+        node, owner, prefix, env = stack.pop()
         for child in ast.iter_child_nodes(node):
             if isinstance(child, _FUNC_NODES):
                 qual = f"{prefix}{child.name}"
                 funcs[qual] = AxisFuncInfo(
                     qualname=qual, lineno=child.lineno, parent=owner,
                 )
-                stack.append((child, qual, f"{qual}."))
+                stack.append((child, qual, f"{qual}.", func_env(child, env)))
             elif isinstance(child, ast.ClassDef):
-                stack.append((child, owner, f"{prefix}{child.name}."))
+                stack.append((child, owner, f"{prefix}{child.name}.", env))
             else:
                 if isinstance(child, ast.Call):
-                    handle_call(child, owner)
-                stack.append((child, owner, prefix))
+                    handle_call(child, owner, env)
+                stack.append((child, owner, prefix, env))
     return funcs, bindings
 
 
@@ -855,9 +924,11 @@ def scrape_mesh_decl(tree: ast.Module) -> MeshDecl:
 CACHE_NAME = ".graftlint_cache.json"
 # v3: axis-environment tables (axis_funcs/axis_bindings) + donation facts
 # (donated_argnums/returns_donating/forwards_donated) joined the summaries.
+# v4: collective axes resolve through string-default ``*axis`` parameters
+# (the ``axis="data"`` factory spelling), not just call-site literals.
 # A version mismatch discards the cache wholesale — cold start, never a
 # half-read of the old schema.
-_CACHE_VERSION = 3
+_CACHE_VERSION = 4
 _FIXPOINT_MAX_ROUNDS = 25
 
 

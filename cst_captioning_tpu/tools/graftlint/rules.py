@@ -1502,21 +1502,55 @@ class _DeviceFlow:
         numpy array of the same values, but device_get is the EXPLICIT
         readback spelling (behavior-identical, sanitizer-legal). Only the
         bare single-argument form is mechanical; dtype=/copy= kwargs
-        change semantics and stay manual."""
-        if attr != "asarray" or not self.device_get_spelling:
+        change semantics and stay manual. A file with no jax import in
+        scope gets ``import jax`` inserted alongside the rewrite — the
+        dedup in check_project keeps that insertion on one finding only
+        (identical spans read as two writers to the fix engine)."""
+        if attr != "asarray":
             return None
         if len(node.args) != 1 or node.keywords:
             return None
-        return Fix(
-            edits=(Edit.from_node(
-                node.func, self.device_get_spelling
-            ),),
-            description=(
-                f"replace {self._describe(node.func)}(...) with "
-                f"{self.device_get_spelling}(...) — the same host "
-                "readback, made explicit"
-            ),
+        spelling = self.device_get_spelling or "jax.device_get"
+        edits = [Edit.from_node(node.func, spelling)]
+        description = (
+            f"replace {self._describe(node.func)}(...) with "
+            f"{spelling}(...) — the same host readback, made explicit"
         )
+        if not self.device_get_spelling:
+            edits.append(self._import_jax_edit())
+            description += " (inserting the missing `import jax`)"
+        return Fix(edits=tuple(edits), description=description)
+
+    def _import_jax_edit(self) -> Edit:
+        """Zero-width insertion of ``import jax`` where the file's layout
+        dictates: after the last ``__future__`` import (those must stay
+        first), else grouped onto the first top-level import, else after
+        the module docstring, else line 1. Never anchors on a non-import
+        statement's ``lineno`` — that would land between a decorator and
+        its def."""
+        body = self.ctx.tree.body
+        line = 1
+        i = 0
+        if body and isinstance(body[0], ast.Expr) and isinstance(
+                body[0].value, ast.Constant) and isinstance(
+                body[0].value.value, str):
+            line = int(body[0].end_lineno or body[0].lineno) + 1
+            i = 1
+        futures = [
+            s for s in body
+            if isinstance(s, ast.ImportFrom) and s.module == "__future__"
+        ]
+        if futures:
+            line = int(futures[-1].end_lineno or futures[-1].lineno) + 1
+        else:
+            first_import = next(
+                (s for s in body[i:]
+                 if isinstance(s, (ast.Import, ast.ImportFrom))), None,
+            )
+            if first_import is not None:
+                line = first_import.lineno
+        return Edit(line=line, col=0, end_line=line, end_col=0,
+                    replacement="import jax\n")
 
     def _describe(self, expr: ast.AST) -> str:
         try:
@@ -1569,7 +1603,34 @@ class ImplicitTransferRule(ProjectRule):
                 scopes.append(node.body)
         for body in scopes:
             out.extend(_DeviceFlow(self, ctx, index, aliases).run(body))
+        self._dedup_import_edits(out)
         return out
+
+    @staticmethod
+    def _is_import_edit(e: Edit) -> bool:
+        return (e.replacement == "import jax\n" and e.line == e.end_line
+                and e.col == 0 and e.end_col == 0)
+
+    @classmethod
+    def _dedup_import_edits(cls, findings: list[Finding]) -> None:
+        """Several findings in one import-less file each want the same
+        zero-width ``import jax`` insertion; the fix engine refuses
+        identical spans as two writers, so only the FIRST fixable finding
+        in source order (the order plan_fixes accepts edits) keeps it —
+        the rest are rebuilt without the insertion, and one ``--fix``
+        pass lands the import exactly once."""
+        kept = False
+        for f in sorted(
+            (f for f in findings if f.fix is not None),
+            key=lambda f: (f.line, f.col),
+        ):
+            if not any(cls._is_import_edit(e) for e in f.fix.edits):
+                continue
+            if kept:
+                f.fix.edits = tuple(
+                    e for e in f.fix.edits if not cls._is_import_edit(e)
+                )
+            kept = True
 
 
 # ---- GL014: cross-function PRNG key reuse -----------------------------------

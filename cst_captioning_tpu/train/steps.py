@@ -62,7 +62,7 @@ def _local_loss_sums(model, params, feats, masks, labels, mask, weights,
 
 
 def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False,
-                 guard: bool = False):
+                 guard: bool = False, comm=None):
     """Single-device jitted step: (state, batch arrays) -> (state, metrics).
 
     ``donate=True`` donates the input ``state`` buffers to the output state
@@ -74,7 +74,11 @@ def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False,
     ``guard=True`` suppresses non-finite updates on device and adds a
     ``nonfinite`` metric (resilience/guard.py); finite steps are bit-equal
     to the unguarded program.
+
+    ``comm`` (parallel/comms.CommConfig) is accepted for factory-signature
+    symmetry and ignored: the single-device step has no collectives.
     """
+    del comm  # no cross-device reduction on this path
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: TrainState, feats, masks, labels, mask, weights):
@@ -95,9 +99,18 @@ def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False,
 
 def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
                           axis: str = "data", donate: bool = False,
-                          guard: bool = False):
+                          guard: bool = False, comm=None):
     """shard_map data-parallel step, exact-equivalent to the fused batch.
-    ``donate`` / ``guard``: see :func:`make_xe_step`."""
+    ``donate`` / ``guard``: see :func:`make_xe_step`.
+
+    ``comm`` (parallel/comms.CommConfig) selects the grad-allreduce spelling:
+    None keeps the original per-leaf psum; otherwise the reduction buckets
+    (and optionally bf16-compresses) per the config. f32 configs are
+    bit-identical to ``comm=None`` — psum is elementwise (tests/test_comms).
+    """
+    # imported lazily: parallel/__init__ -> seq_parallel imports this module,
+    # so a module-level import here would close the cycle mid-initialization
+    from cst_captioning_tpu.parallel.comms import reduce_tree
 
     def device_step(state: TrainState, feats, masks, labels, mask, weights):
         drng = jax.random.fold_in(
@@ -116,8 +129,8 @@ def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
         den_total = jax.lax.psum(den, axis)
         num_total = jax.lax.psum(num, axis)
         grads = jax.tree.map(
-            lambda g: jax.lax.psum(g, axis) / jnp.maximum(den_total, 1.0),
-            grads_num,
+            lambda g: g / jnp.maximum(den_total, 1.0),
+            reduce_tree(grads_num, axis, comm),
         )
         loss = num_total / jnp.maximum(den_total, 1.0)
         gnorm = optax.global_norm(grads)

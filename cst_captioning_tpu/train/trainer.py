@@ -51,6 +51,7 @@ from cst_captioning_tpu.eval.evaluator import Evaluator
 from cst_captioning_tpu.metrics.cider import CorpusDF
 from cst_captioning_tpu.models import CaptionModel
 from cst_captioning_tpu.parallel import (
+    CommConfig,
     make_sp_xe_step,
     sp_batch_shardings,
     sp_model,
@@ -281,6 +282,12 @@ class Trainer:
         """(Re)build the jitted XE step for the CURRENT mesh — called at init
         and again after a degraded-mesh rebuild."""
         cfg = self.cfg
+        # the grad-allreduce spelling (parallel/comms.py): bucketing/dtype/
+        # overlap from the train.comm_* knobs, shared with the RL update
+        comm = CommConfig.from_train(cfg.train)
+        # a new jitted step means the compile-time FLOPs probe must re-run
+        # (a degraded-mesh rebuild changes the program)
+        self._xe_cost = None
         if self.mesh is not None:
             if self.sp:
                 # SP params are layout-identical to the plain model's, so the
@@ -291,17 +298,34 @@ class Trainer:
                 self.xe_step = make_sp_xe_step(
                     sp_model(cfg.model), self.mesh, cfg.train.label_smoothing,
                     data_axis="data", donate=True, guard=self.guard,
+                    comm=comm,
                 )
             else:
                 self.xe_step = make_parallel_xe_step(
                     self.model, self.mesh, cfg.train.label_smoothing,
-                    donate=True, guard=self.guard,
+                    donate=True, guard=self.guard, comm=comm,
                 )
         else:
             self.xe_step = make_xe_step(
                 self.model, cfg.train.label_smoothing, donate=True,
-                guard=self.guard,
+                guard=self.guard, comm=comm,
             )
+
+    def _xe_flops_inc(self, rows, args) -> float:
+        """Per-process FLOPs to count for one XE step. Prefers the COMPILED
+        program's own cost (obs/flops.compiled_cost) so the MFU column and
+        bench_comms agree on what a step costs; analytic per-row model when
+        XLA exposes no cost or obs is off (the probe forces an AOT compile
+        walk — skip it when nothing reads the counter). The compiled number
+        is the whole (global-batch) program, split evenly across processes
+        so per-process streams still sum to the global total; the analytic
+        one counts this host's rows directly."""
+        if self._xe_cost is None and obs.enabled():
+            cost = _flops.compiled_cost(self.xe_step, *args)
+            self._xe_cost = cost["flops"] if cost else False
+        if self._xe_cost:
+            return self._xe_cost / jax.process_count()
+        return rows * self._xe_flops_per_row
 
     def _build_validator(self) -> None:
         cfg = self.cfg
@@ -881,8 +905,14 @@ class Trainer:
                         profiler.tick()
                         meter.tick(cfg.data.batch_size, first=run["first_step"])
                         run["first_step"] = False
+                        # self.state is the step's OUTPUT here (same shapes;
+                        # the donated input is already consumed) — safe to
+                        # lower against for the one-time cost probe
                         obs.counter("flops.xe.step").inc(
-                            cfg.data.batch_size * self._xe_flops_per_row
+                            self._xe_flops_inc(cfg.data.batch_size, (
+                                self.state, feats, masks, labels, mask,
+                                weights,
+                            ))
                         )
                         chaos.visit("xe.step")
                         if self.health is not None:
@@ -993,6 +1023,7 @@ class Trainer:
                 self.model, reward, cfg.rl, mesh=self.mesh,
                 max_len=cfg.model.max_len, donate=True, guard=self.guard,
                 on_event=self.log.log,
+                comm=CommConfig.from_train(cfg.train),
             )
             rl_batcher = Batcher(
                 self.train_ds,

@@ -23,15 +23,15 @@ cd "$(dirname "$0")/.."
 # stay under 2 s.
 python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
-    bench.py bench_attention.py bench_decode.py bench_recipe.py \
-    bench_serving.py \
+    bench.py bench_attention.py bench_comms.py bench_decode.py \
+    bench_recipe.py bench_serving.py \
     --fix-check --check-stale --timings --budget 2
 
 # catches syntax errors in files graftlint may not reach (non-.py-suffixed
 # entry points aside, this is the whole tree)
 python -m compileall -q cst_captioning_tpu tests scripts \
-    bench.py bench_attention.py bench_decode.py bench_recipe.py \
-    bench_serving.py
+    bench.py bench_attention.py bench_comms.py bench_decode.py \
+    bench_recipe.py bench_serving.py
 
 # obs_report smoke check: the report CLI must aggregate a known-good run dir
 # without a jax import or backend init (it is part of the operator loop for
@@ -43,6 +43,12 @@ python -m cst_captioning_tpu.cli.obs_report tests/fixtures/obs_run > /dev/null
 # bit-exactness gate inside — keeps bench_decode.py and the kernel from
 # rotting without a TPU in CI (README "Decode fast path")
 JAX_PLATFORMS=cpu python bench_decode.py --smoke > /dev/null
+
+# comms smoke: tiny-dims CPU run of all allreduce rungs (per-leaf /
+# bucketed / bucketed+bf16 / overlapped) with the in-run parity block
+# inside — keeps bench_comms.py and parallel/comms.py honest without a
+# TPU in CI (README "Gradient communication")
+JAX_PLATFORMS=cpu python bench_comms.py --smoke > /dev/null
 
 # serving smoke: tiny seeded Poisson+bursty traces through the continuous
 # engine AND the static-batching reference — asserts goodput > 0 and the

@@ -1339,6 +1339,82 @@ def test_gl016_shard_map_without_axis_names_binds_all_mesh_axes(tmp_path):
     assert findings == []
 
 
+def test_gl016_string_default_axis_param_unbound_is_finding(tmp_path):
+    """The ``axis="data"`` factory spelling: an axis routed through a
+    string-default parameter resolves like a literal, so a helper whose
+    only caller is an ordinary function IS a finding — this is the
+    carry-over GL016 previously could not see."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def helper(x, axis='data'):\n"
+        "    return jax.lax.psum(x, axis)\n"
+        "def epoch(xs):\n"
+        "    return [helper(x) for x in xs]\n"
+    ), rules=["GL016"])
+    assert _rules_of(findings) == ["GL016"]
+    assert findings[0].line == 3 and "'data'" in findings[0].message
+
+
+def test_gl016_string_default_axis_inherited_by_nested_def(tmp_path):
+    """The make_*_step closure spelling: the nested device fn inherits
+    the factory's ``axis="data"`` default; bound via shard_map the
+    collective stays quiet, called plainly it is a finding."""
+    src = (
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "def make_step(mesh, axis='data'):\n"
+        "    def device_step(x):\n"
+        "        return jax.lax.psum(x, axis)\n"
+        "    return shard_map(device_step, mesh=mesh,\n"
+        "                     in_specs=None, out_specs=None)\n"
+    )
+    assert _lint(tmp_path / "bound", "cst_captioning_tpu/mod.py", src,
+                 rules=["GL016"]) == []
+    plain = src.replace(
+        "    return shard_map(device_step, mesh=mesh,\n"
+        "                     in_specs=None, out_specs=None)\n",
+        "    return device_step(0)\n"
+        "def epoch(mesh, xs):\n"
+        "    return [make_step(mesh) for x in xs]\n",
+    )
+    findings = _lint(tmp_path / "plain", "cst_captioning_tpu/mod.py",
+                     plain, rules=["GL016"])
+    assert _rules_of(findings) == ["GL016"]
+    assert findings[0].line == 5
+
+
+def test_gl016_empty_string_axis_default_resolves_to_nothing(tmp_path):
+    """The SP factories spell ``data_axis: str = ""`` for "no data
+    axis"; an empty default must NOT be recorded as an axis (and the
+    call site stays unresolvable, hence quiet)."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def helper(x, data_axis=''):\n"
+        "    if data_axis:\n"
+        "        return jax.lax.psum(x, data_axis)\n"
+        "    return x\n"
+        "def epoch(xs):\n"
+        "    return [helper(x) for x in xs]\n"
+    ), rules=["GL016"])
+    assert findings == []
+
+
+def test_gl016_reassigned_axis_param_drops_out_of_env(tmp_path):
+    """A rebind of the string-default parameter makes it unresolvable
+    again — never guess the default still holds."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/mod.py", (
+        "import jax\n"
+        "def helper(x, axis='data'):\n"
+        "    axis = pick_axis(x)\n"
+        "    return jax.lax.psum(x, axis)\n"
+        "def pick_axis(x):\n"
+        "    return 'seq'\n"
+        "def epoch(xs):\n"
+        "    return [helper(x) for x in xs]\n"
+    ), rules=["GL016"])
+    assert findings == []
+
+
 # ---- GL017: interprocedural donation hazards --------------------------------
 
 def test_gl017_cross_file_donation_hazards():
@@ -1490,6 +1566,73 @@ def test_fix_applies_and_is_idempotent(tmp_path, capsys):
     before = fixed
     assert cli_main(args + ["--fix"]) == 0
     assert (tmp_path / "cst_captioning_tpu/consumer.py").read_text() == before
+
+
+_FIXABLE_GL013_NO_JAX = {
+    "cst_captioning_tpu/producer.py":
+        _FIXABLE_GL013["cst_captioning_tpu/producer.py"],
+    # no `import jax` anywhere — the fix must insert it (once, despite
+    # two findings wanting it)
+    "cst_captioning_tpu/consumer.py": (
+        "import numpy as np\n"
+        "from cst_captioning_tpu.producer import decode\n"
+        "def to_host(feats):\n"
+        "    tokens = decode(feats)\n"
+        "    return np.asarray(tokens)\n"
+        "def to_host_twice(feats):\n"
+        "    tokens = decode(feats)\n"
+        "    return np.asarray(tokens)\n"
+    ),
+}
+
+
+def test_fix_inserts_missing_jax_import(tmp_path, capsys):
+    """A consumer with NO jax import still gets the mechanical rewrite:
+    --fix inserts ``import jax`` exactly once (grouped onto the first
+    import), rewrites BOTH sinks, relints clean, and stays idempotent."""
+    _write_repo(tmp_path, _FIXABLE_GL013_NO_JAX)
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache"]
+    assert cli_main(args + ["--fix"]) == 0
+    capsys.readouterr()
+    fixed = (tmp_path / "cst_captioning_tpu/consumer.py").read_text()
+    lines = fixed.splitlines()
+    assert lines[0] == "import jax" and lines[1] == "import numpy as np"
+    assert fixed.count("import jax\n") == 1
+    assert fixed.count("jax.device_get(tokens)") == 2
+    assert "np.asarray" not in fixed
+    assert cli_main(args) == 0  # tree is lint-clean after the fix
+    before = fixed
+    assert cli_main(args + ["--fix"]) == 0
+    assert (tmp_path / "cst_captioning_tpu/consumer.py").read_text() == before
+
+
+def test_fix_import_insertion_respects_future_imports(tmp_path, capsys):
+    """``from __future__ import ...`` must stay first in the file: the
+    inserted ``import jax`` lands after the last future import (and
+    after the module docstring)."""
+    files = dict(_FIXABLE_GL013_NO_JAX)
+    files["cst_captioning_tpu/consumer.py"] = (
+        '"""Reads captions back to host."""\n'
+        "from __future__ import annotations\n"
+        "import numpy as np\n"
+        "from cst_captioning_tpu.producer import decode\n"
+        "def to_host(feats):\n"
+        "    tokens = decode(feats)\n"
+        "    return np.asarray(tokens)\n"
+    )
+    _write_repo(tmp_path, files)
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache"]
+    assert cli_main(args + ["--fix"]) == 0
+    capsys.readouterr()
+    lines = (
+        tmp_path / "cst_captioning_tpu/consumer.py"
+    ).read_text().splitlines()
+    assert lines[1] == "from __future__ import annotations"
+    assert lines[2] == "import jax"
+    assert cli_main(args) == 0
+    assert cli_main(args + ["--fix"]) == 0  # idempotent
 
 
 def test_fix_dry_run_prints_diff_and_writes_nothing(tmp_path, capsys):
@@ -1713,9 +1856,11 @@ def test_cache_schema_bump_cold_starts_cleanly(tmp_path):
                              cache_path=str(cache))
     assert idx.stats.summarized == 1 and idx.stats.cached == 0
     assert idx.functions["m.update"].donated_argnums == [0]
-    # the rewritten cache is v3 and round-trips the new fields
+    # the rewritten cache carries the current schema version and
+    # round-trips the new fields
+    from cst_captioning_tpu.tools.graftlint.project import _CACHE_VERSION
     data = json.loads(cache.read_text())
-    assert data["version"] == 3
+    assert data["version"] == _CACHE_VERSION
     idx2 = ProjectIndex.build([str(mod)], str(tmp_path),
                               cache_path=str(cache))
     assert idx2.stats.cached == 1
