@@ -214,6 +214,9 @@ def main() -> None:
         max(r.latency_s for r in solo_rep.results.values()),
     )
     slo_s = args.slo_factor * solo
+    # arm the engine's burn-rate monitor with the calibrated SLO: the bench's
+    # goodput gate and the live serving.slo.* gauges judge the same target
+    svc.set_slo(slo_s)
     rate = args.load * capacity / solo
     print(f"bench_serving: solo={solo * 1e3:.1f}ms slo={slo_s * 1e3:.1f}ms "
           f"rate={rate:.2f}rps", file=sys.stderr)
@@ -320,6 +323,19 @@ def main() -> None:
                 "bench_serving: SMOKE FAILURE — parity or goodput gate "
                 f"failed: parity={parity_ok}, traces={traces_out}"
             )
+        # the SLO monitor must have judged the served traffic: target gauge
+        # armed by set_slo() and per-window attainment/burn-rate populated
+        from cst_captioning_tpu.obs import metrics as obs_metrics
+        gauges = obs_metrics.snapshot()["gauges"]
+        slo_gauges = ("serving.slo.target_s", "serving.slo.attainment.60s",
+                      "serving.slo.burn_rate.60s")
+        missing = [g for g in slo_gauges if gauges.get(g) is None]
+        if missing or gauges["serving.slo.target_s"] <= 0.0:
+            sys.exit(
+                "bench_serving: SMOKE FAILURE — SLO gauges not populated: "
+                f"missing={missing}, "
+                f"target_s={gauges.get('serving.slo.target_s')}"
+            )
 
     out = {
         "metric": "serving_request_latency_and_slo_goodput",
@@ -335,6 +351,7 @@ def main() -> None:
         "solo_latency_s": round(solo, 4),
         "slo_s": round(slo_s, 4),
         "slo_factor": args.slo_factor,
+        "slo_monitor": svc.slo_snapshot(),
         "offered_load": args.load,
         "traces": traces_out,
         "parity": {

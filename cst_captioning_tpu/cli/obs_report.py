@@ -1,14 +1,21 @@
 """Run report CLI: phase breakdown + resilience summary from an obs run dir.
 
     python -m cst_captioning_tpu.cli.obs_report <run_dir> [--json]
+    python -m cst_captioning_tpu.cli.obs_report --postmortem <bundle> [--json]
 
 ``<run_dir>`` is the directory ``train.obs_dir`` (or ``--obs``) pointed a
 run at — it must contain the run's ``events.jsonl``. Prints the phase table
-(per-phase totals, self-time %-of-wall-clock, analytic-FLOPs mfu,
+(per-phase totals, self-time %-of-wall-clock, mfu with its FLOPs-source tag,
 p50/p95/max), the decode early-exit summary (scan depth vs the T budget),
-and the resilience summary (nan-skips, rollbacks, retries, chaos faults).
-Pure stdlib — no jax import, safe anywhere (scripts/lint.sh runs it as a
-smoke check against the committed fixture run).
+the serving funnel + SLO burn rates, and the resilience summary (nan-skips,
+rollbacks, retries, chaos faults).
+
+``--postmortem`` renders a flight-recorder bundle
+(``postmortem_*/`` under the run dir, obs/recorder.py) instead: manifest
+verification, the trip context, and the ring as a step timeline with
+anomaly verdicts inline. Pure stdlib — no jax import, safe anywhere
+(scripts/lint.sh runs both modes as smoke checks against committed
+fixtures).
 """
 
 from __future__ import annotations
@@ -17,7 +24,12 @@ import argparse
 import json
 import sys
 
-from cst_captioning_tpu.obs.report import render_report, report_run
+from cst_captioning_tpu.obs.report import (
+    load_postmortem,
+    render_postmortem,
+    render_report,
+    report_run,
+)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -26,11 +38,24 @@ def main(argv: list[str] | None = None) -> int:
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    p.add_argument("run_dir", help="obs run directory (holds events.jsonl)")
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="obs run directory (holds events.jsonl)")
+    p.add_argument("--postmortem", metavar="BUNDLE", default=None,
+                   help="render a flight-recorder postmortem bundle dir "
+                        "instead of a run dir")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the machine-readable report on stdout")
     args = p.parse_args(argv)
+    if args.postmortem is None and args.run_dir is None:
+        p.error("a run_dir (or --postmortem BUNDLE) is required")
     try:
+        if args.postmortem is not None:
+            pm = load_postmortem(args.postmortem)
+            if args.as_json:
+                print(json.dumps(pm, indent=2, default=float))
+            else:
+                print(render_postmortem(pm))
+            return 0
         report = report_run(args.run_dir)
     except FileNotFoundError as e:
         print(f"obs_report: {e}", file=sys.stderr)

@@ -214,6 +214,11 @@ class TrainConfig:
                 f"comm_bucket_mb {self.comm_bucket_mb} must be >= 0 "
                 "(0 = one message per leaf)"
             )
+        if self.recorder_steps < 0:
+            raise ValueError(
+                f"recorder_steps {self.recorder_steps} must be >= 0 "
+                "(0 = flight recorder off)"
+            )
     # per-step JSONL events (loss/reward + grad_norm every N steps; 0 = off,
     # keeping logs to per-epoch summaries)
     log_every_steps: int = 0
@@ -278,6 +283,16 @@ class TrainConfig:
     # buffered carry). Needs rl.update_chunks >= 2; trades (chunks+1)x wire
     # bytes for latency hiding — see the README section before enabling
     comm_overlap: bool = False
+    # ---- flight recorder + anomaly detection (obs/recorder.py, obs/anomaly.py;
+    # README "Observability"): ring capacity in steps for the black-box
+    # per-step record buffer (0 = off; requires `obs`). On divergence/
+    # rollback/chaos/preemption the ring dumps as a postmortem bundle under
+    # the obs dir, rendered by `cli.obs_report --postmortem <bundle>`
+    recorder_steps: int = 0
+    # online EWMA z-score + stall detection over the recorder's loss/
+    # grad-norm/reward/step-time streams; verdicts land inline in the ring
+    # records and as `anomaly` events + obs.anomaly.<kind> counters
+    anomaly: bool = False
 
 
 @dataclass(frozen=True)

@@ -11,6 +11,13 @@ resilience (README "Observability"):
   exported as a Prometheus textfile.
 - :mod:`obs.report`  — aggregates a run dir into the phase-breakdown +
   resilience report behind ``python -m cst_captioning_tpu.cli.obs_report``.
+- :mod:`obs.recorder` — the training-dynamics flight recorder: a ring of
+  per-step records flushed with one batched readback, dumped as a durable
+  postmortem bundle when a run trips (README "Observability").
+- :mod:`obs.anomaly` — online EWMA z-score + stall anomaly detection over
+  the recorder's streams; every producer (recorder, divergence sentinel,
+  serving SLO monitor) reports through ``anomaly.record_anomaly`` so the
+  ``anomaly`` events and ``obs.anomaly.<kind>`` counters share one spelling.
 
 Stdlib-only at import time (jax is touched lazily, for the optional
 device-memory gauges and the jax.monitoring compile listener), and
@@ -37,6 +44,7 @@ from cst_captioning_tpu.obs.span import (
     shutdown,
     snapshot_metrics,
     span,
+    wall_time,
 )
 
 __all__ = [
@@ -57,4 +65,5 @@ __all__ = [
     "snapshot",
     "snapshot_metrics",
     "span",
+    "wall_time",
 ]

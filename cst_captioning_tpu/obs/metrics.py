@@ -24,6 +24,7 @@ same registry, and the obs recorder snapshots it into the event stream.
 from __future__ import annotations
 
 import bisect
+import math
 import threading
 import time
 from typing import Any, Iterable
@@ -211,8 +212,14 @@ def _prom_name(name: str) -> str:
 
 
 def _prom_num(v: float) -> str:
-    # integers render bare so counters read naturally; floats use repr
-    return str(int(v)) if float(v).is_integer() else repr(float(v))
+    # Prometheus spells specials "NaN"/"+Inf"/"-Inf" (repr would emit "nan",
+    # and int(inf) raises); integers render bare so counters read naturally
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return str(int(f)) if f.is_integer() else repr(f)
 
 
 REGISTRY = Registry()

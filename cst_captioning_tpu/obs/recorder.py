@@ -1,0 +1,346 @@
+"""Black-box flight recorder: a ring of per-step records + postmortem bundles.
+
+The obs event stream answers *where the time went*; the flight recorder
+answers *what training looked like right before it died*. It keeps the last
+``capacity`` steps' structured records (loss, grad-norm, per-family update
+ratios, reward/advantage stats, sampled-lane entropy, comms/compaction/health
+probes, anomaly verdicts) in a fixed-size ring, and on any trip — sentinel
+divergence, rollback, chaos fault, SIGTERM/peer-loss drain, atexit crash —
+dumps the ring plus its context as a durable **postmortem bundle** that
+``cli.obs_report --postmortem`` renders as a step-by-step timeline.
+
+Hot-path contract (graftlint GL001/GL013):
+
+- :meth:`FlightRecorder.record` buffers the step's *device* scalars as-is —
+  no ``float()``, no ``device_get``, one host ``perf_counter`` read; the
+  step loops stay zero-sync.
+- :meth:`FlightRecorder.flush` performs ONE ``jax.device_get`` over
+  everything buffered (the sentinel's batched-readback pattern) on the
+  existing ``log_every_steps`` / sentinel-flush cadence, finalizes the ring
+  records, and feeds the anomaly detector (:mod:`obs.anomaly`).
+- Everything is a no-op when no recorder is configured (``train.obs`` off or
+  ``train.recorder_steps == 0``).
+
+Bundle layout (all files manifest-checksummed via :mod:`resilience.durable`,
+written tmp-dir-then-rename like a checkpoint)::
+
+    postmortem_<n>_<reason>/
+      ring.jsonl         one JSON line per ring record, oldest first
+      registry.json      full metrics-registry snapshot at dump time
+      events_tail.jsonl  last lines of the live obs event stream
+      config.json        the run's resolved config (as configured)
+      meta.json          reason, trip fields, ring coverage, schema version
+      manifest.json      sha256 + size per file (durable.write_manifest)
+
+jax and :mod:`resilience.durable` are imported lazily (flush/dump time): the
+module itself stays importable from jax-free contexts (the chaos harness
+hooks in from the prefetch thread; ``cli.obs_report`` never pulls it in).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import re
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from cst_captioning_tpu.obs import metrics as _metrics
+# name imports, not `obs import span`: the obs package re-exports the span()
+# context-manager FUNCTION under that name, shadowing the submodule
+from cst_captioning_tpu.obs.span import (
+    active as _span_active,
+    event as _span_event,
+    wall_time as _wall_time,
+)
+
+# registry metrics attached to every flush batch as the records' ``probe``
+# field: the host-side run state a postmortem wants next to the step scalars
+_PROBE_GAUGES = (
+    "comm.bytes_on_wire", "comm.buckets", "health.peers_alive",
+    "health.peer_age_max_s", "serving.slo.burn_rate.60s",
+)
+_PROBE_COUNTERS = (
+    "rl.decode.compaction.lanes_stepped",
+    "rl.decode.compaction.lanes_skipped",
+    "resilience.nan_skip", "resilience.rollback", "resilience.chaos_fault",
+    "health.peer_lost",
+)
+
+_EVENTS_TAIL_LINES = 200
+
+
+def _sanitize(reason: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", reason)[:64] or "unknown"
+
+
+class FlightRecorder:
+    """Ring buffer of per-step records + the postmortem dump machinery."""
+
+    def __init__(self, capacity: int, out_dir: str, run: str = "run",
+                 detector=None, config: dict | None = None,
+                 max_dumps: int = 4,
+                 probe: Callable[[], dict] | None = None):
+        if capacity < 1:
+            raise ValueError(f"recorder capacity {capacity} must be >= 1")
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self.run = run
+        self.detector = detector
+        self.config = config or {}
+        self.max_dumps = max_dumps
+        self.probe = probe
+        self.ring: deque[dict] = deque(maxlen=capacity)
+        self._buf: list[tuple[int, str, Any, float]] = []
+        self._lock = threading.Lock()
+        self._dumps = 0
+        self._last_t: float | None = None
+        self._closed = False
+        # perf_counter -> wall-clock mapping fixed at configure time: records
+        # get absolute timestamps without a wall-clock read per step
+        self._pc0 = time.perf_counter()
+        self._wall0 = _wall_time()
+        self._atexit = self._crash_dump
+        atexit.register(self._atexit)
+
+    # ---- hot path -----------------------------------------------------------
+
+    def record(self, step: int, phase: str, scalars: dict) -> None:
+        """Buffer one step's scalars (device arrays and/or host floats) —
+        zero-sync: values are not read here, only held until :meth:`flush`."""
+        t = time.perf_counter()
+        with self._lock:
+            self._buf.append((step, phase, scalars, t))
+
+    def flush(self) -> None:
+        """ONE host readback for every buffered step, then ring + anomaly
+        finalization. Safe from any thread; no-op when nothing is buffered."""
+        with self._lock:
+            buf, self._buf = self._buf, []
+        if not buf:
+            return
+        import jax  # lazy: keep the module importable jax-free
+
+        values = jax.device_get([scalars for _, _, scalars, _ in buf])
+        probe = self._probe()
+        with self._lock:
+            for (step, phase, _, t), vals in zip(buf, values):
+                rec: dict[str, Any] = {
+                    "step": int(step),
+                    "phase": phase,
+                    "ts": self._wall0 + (t - self._pc0),
+                }
+                for k, v in vals.items():
+                    rec[k] = float(v)
+                if probe:
+                    rec["probe"] = probe
+                rec["anomalies"] = self._judge(rec, t)
+                self.ring.append(rec)
+
+    def _judge(self, rec: dict, t: float) -> list[str]:
+        last_t, self._last_t = self._last_t, t
+        det = self.detector
+        if det is None:
+            return []
+        step, phase = rec["step"], rec["phase"]
+        out: list[str] = []
+        loss = rec.get("loss", rec.get("rl_loss"))
+        if loss is not None:
+            out += det.observe("loss", loss, step=step, phase=phase)
+        if "grad_norm" in rec:
+            out += det.observe("grad_norm", rec["grad_norm"], step=step,
+                               phase=phase)
+        if "reward_mean" in rec:
+            out += det.observe("reward", rec["reward_mean"], step=step,
+                               phase=phase)
+        if last_t is not None:
+            gap = t - last_t
+            out += det.observe("step_time", gap, step=step, phase=phase)
+            out += det.observe_gap(gap, step=step, phase=phase)
+        # dedupe, order-preserving: loss AND grad_norm going non-finite on
+        # the same step is one verdict, not two
+        return list(dict.fromkeys(out))
+
+    def _probe(self) -> dict:
+        if self.probe is not None:
+            try:
+                return dict(self.probe())
+            except Exception:
+                return {}
+        out: dict[str, float] = {}
+        snap = _metrics.snapshot()
+        for name in _PROBE_GAUGES:
+            v = snap["gauges"].get(name)
+            if v is not None:
+                out[name] = float(v)
+        for name in _PROBE_COUNTERS:
+            v = snap["counters"].get(name)
+            if v is not None:
+                out[name] = float(v)
+        return out
+
+    # ---- postmortem bundles -------------------------------------------------
+
+    def postmortem(self, reason: str, **fields: Any) -> str | None:
+        """Flush, then dump the ring + context as a durable bundle.
+
+        Returns the bundle directory, or ``None`` when the per-process dump
+        budget (``max_dumps``) is spent — a run stuck in a divergence loop
+        must not fill the disk with identical bundles."""
+        flush_error = ""
+        try:
+            self.flush()
+        except Exception as e:
+            # a dying process still gets the already-flushed ring; the
+            # failure itself is evidence and rides along in meta.json
+            flush_error = f"{type(e).__name__}: {e}"
+        with self._lock:
+            if self._dumps >= self.max_dumps:
+                return None
+            self._dumps += 1
+            n = self._dumps
+            ring = list(self.ring)
+        # lazy: resilience.__init__ pulls jax via the sentinel; only dump
+        # paths (never import time) pay that
+        from cst_captioning_tpu.resilience import durable
+
+        name = f"postmortem_{n:02d}_{_sanitize(reason)}"
+        final = os.path.join(self.out_dir, name)
+        tmp = final + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        meta = {
+            "schema": 1,
+            "reason": reason,
+            "run": self.run,
+            "capacity": self.ring.maxlen,
+            "steps": [r["step"] for r in ring],
+            "dumped_ts": _wall_time(),
+            **fields,
+        }
+        if flush_error:
+            meta["flush_error"] = flush_error
+        blobs = {
+            "ring.jsonl": "".join(
+                json.dumps(r, default=float) + "\n" for r in ring
+            ).encode(),
+            "registry.json": json.dumps(
+                _metrics.snapshot(), default=float, indent=2
+            ).encode(),
+            "events_tail.jsonl": self._events_tail(),
+            "config.json": json.dumps(
+                self.config, default=str, indent=2
+            ).encode(),
+            "meta.json": json.dumps(meta, default=float, indent=2).encode(),
+        }
+        for fname, blob in blobs.items():
+            durable.write_bytes_durable(os.path.join(tmp, fname), blob)
+        durable.write_manifest(tmp, blobs)
+        durable.fsync_dir(tmp)
+        if os.path.exists(final):  # stale bundle from a prior run: keep ours
+            final = final + "_" + str(int(meta["dumped_ts"]))
+        os.replace(tmp, final)
+        durable.fsync_dir(self.out_dir)
+        _span_event("postmortem", reason=reason, bundle=final,
+                    steps=len(ring))
+        return final
+
+    def _events_tail(self) -> bytes:
+        """Last lines of the live obs event stream (line-buffered on disk, so
+        this is current up to the latest emit)."""
+        rec = _span_active()
+        if rec is None:
+            return b""
+        path = os.path.join(rec.out_dir, "events.jsonl")
+        try:
+            with open(path, "rb") as f:
+                lines = f.readlines()
+        except OSError:
+            return b""
+        return b"".join(lines[-_EVENTS_TAIL_LINES:])
+
+    # ---- lifecycle ----------------------------------------------------------
+
+    def _crash_dump(self) -> None:
+        """atexit hook: a process that never reached :meth:`close` died with
+        work in flight — dump what the ring holds."""
+        try:
+            self.postmortem("atexit_crash")
+        except Exception as e:
+            # interpreter teardown: the event stream may already be closed,
+            # stderr is the only sink left standing
+            sys.stderr.write(f"flight-recorder: atexit dump failed: {e}\n")
+
+    def close(self) -> None:
+        """Clean shutdown: final flush, no dump, atexit hook disarmed."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._atexit is not None:
+            atexit.unregister(self._atexit)
+            self._atexit = None
+        try:
+            self.flush()
+        except Exception as e:
+            sys.stderr.write(f"flight-recorder: final flush failed: {e}\n")
+
+
+# ---- process-global wiring (mirrors obs.span's configure/active) ------------
+
+_FLIGHT: FlightRecorder | None = None
+
+
+def configure(capacity: int, out_dir: str, run: str = "run", detector=None,
+              config: dict | None = None, max_dumps: int = 4,
+              probe: Callable[[], dict] | None = None) -> FlightRecorder:
+    """Install the process-global flight recorder (closing any previous)."""
+    global _FLIGHT
+    if _FLIGHT is not None:
+        _FLIGHT.close()
+    _FLIGHT = FlightRecorder(capacity, out_dir, run=run, detector=detector,
+                             config=config, max_dumps=max_dumps, probe=probe)
+    return _FLIGHT
+
+
+def shutdown() -> None:
+    """Cleanly close and uninstall the recorder (no crash dump)."""
+    global _FLIGHT
+    if _FLIGHT is not None:
+        _FLIGHT.close()
+        _FLIGHT = None
+
+
+def active() -> FlightRecorder | None:
+    return _FLIGHT
+
+
+def record(step: int, phase: str, scalars: dict) -> None:
+    """Buffer one step's scalars on the global recorder (no-op when off)."""
+    fr = _FLIGHT
+    if fr is not None:
+        fr.record(step, phase, scalars)
+
+
+def flush() -> None:
+    fr = _FLIGHT
+    if fr is not None:
+        fr.flush()
+
+
+def postmortem(reason: str, **fields: Any) -> str | None:
+    fr = _FLIGHT
+    if fr is not None:
+        return fr.postmortem(reason, **fields)
+    return None
+
+
+def note_fault(point: str, kind: str, visit: int) -> None:
+    """Chaos-harness hook (lazy-imported from resilience/chaos.py): an
+    injected fault is a trip — capture the ring as it was when the fault
+    fired, before its consequences land."""
+    fr = _FLIGHT
+    if fr is not None:
+        fr.postmortem(f"chaos_{kind}", point=point, visit=visit)

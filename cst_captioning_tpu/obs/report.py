@@ -39,6 +39,7 @@ sections (PR 4):
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -179,6 +180,12 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
         for name, agg in groups.items():
             durs = sorted(agg["durs"])
             flops = float(counters.get(f"flops.{name}", 0.0))
+            # which FLOPs source the mfu cell reflects: the trainer/SCST/
+            # serving loops publish flops.backend.<phase> = 1.0 when the
+            # counter accumulates the COMPILED program's XLA cost, 0.0 for
+            # the analytic matmul model (obs/flops.py); absent = the phase
+            # predates the probe or never counted FLOPs
+            backend = gauges.get(f"flops.backend.{name}")
             out.append({
                 "phase": name,
                 "count": agg["count"],
@@ -193,6 +200,10 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
                 "mfu": (
                     flops / wall / peak if flops and wall > 0 and peak > 0
                     else None
+                ),
+                "flops_backend": (
+                    None if backend is None
+                    else ("compiled" if backend else "analytic")
                 ),
                 "p50_s": _percentile(durs, 0.50),
                 "p95_s": _percentile(durs, 0.95),
@@ -268,6 +279,31 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
             "slots_in_use": gauges.get("serving.slots_in_use"),
             "queue_depth": gauges.get("serving.queue_depth"),
         }
+        # SLO burn-rate monitor (serving/engine.SloMonitor): rolling-window
+        # attainment/burn gauges + breach/alert counters, keyed by window
+        slo_windows = sorted(
+            int(m.group(1)) for m in (
+                re.match(r"serving\.slo\.attainment\.(\d+)s$", k)
+                for k in gauges
+            ) if m
+        )
+        if slo_windows:
+            serving["slo"] = {
+                "target_s": gauges.get("serving.slo.target_s"),
+                "windows": {
+                    w: {
+                        "attainment": gauges.get(
+                            f"serving.slo.attainment.{w}s"
+                        ),
+                        "burn_rate": gauges.get(
+                            f"serving.slo.burn_rate.{w}s"
+                        ),
+                    }
+                    for w in slo_windows
+                },
+                "breaches": counters.get("serving.slo.breaches", 0),
+                "alerts": counters.get("serving.slo.alerts", 0),
+            }
 
     resilience = {
         "nan_skips": counters.get("resilience.nan_skip", 0),
@@ -367,10 +403,19 @@ def render_report(report: dict[str, Any]) -> str:
     lines.append(hdr)
     lines.append("-" * len(hdr))
     mfu_total = 0.0
+    backends_seen = set()
     for p in report["phases"]:
         mfu = p.get("mfu")
         mfu_total += mfu or 0.0
-        mfu_col = f"{mfu:7.4f}" if mfu is not None else " " * 7
+        backend = p.get("flops_backend")
+        if mfu is not None:
+            # single-char FLOPs-source tag on the mfu cell: c = compiled
+            # XLA cost, a = analytic model (legend below the table)
+            mark = {"compiled": "c", "analytic": "a"}.get(backend, " ")
+            backends_seen.add(mark.strip() or None)
+            mfu_col = f"{mfu:6.4f}{mark}"
+        else:
+            mfu_col = " " * 7
         lines.append(
             f"{p['phase']:<16} {p['count']:>6} {_fmt_s(p['total_s'])} "
             f"{_fmt_s(p['self_s'])} {p['pct_wall']:>6.1f} {mfu_col} "
@@ -382,6 +427,11 @@ def render_report(report: dict[str, Any]) -> str:
         f"{100.0 * report['coverage']:>6.1f}"
         + (f" {mfu_total:7.4f}" if mfu_total else "")
     )
+    if backends_seen - {None}:
+        lines.append(
+            "mfu flops source: c = compiled program (XLA cost analysis), "
+            "a = analytic matmul model"
+        )
     if report["overlap"]:
         lines.append("")
         lines.append("overlapped work (background threads / virtual tracks,"
@@ -428,6 +478,20 @@ def render_report(report: dict[str, Any]) -> str:
                     f"{p['p50_s']:.4f}s  p95 {p['p95_s']:.4f}s  max "
                     f"{p['max_s']:.4f}s"
                 )
+        slo = sv.get("slo")
+        if slo:
+            target = slo.get("target_s")
+            win_bits = "   ".join(
+                f"{w}s: {100.0 * (v['attainment'] or 0.0):.1f}% "
+                f"(burn {v['burn_rate'] or 0.0:.1f}x)"
+                for w, v in sorted(slo["windows"].items())
+            )
+            lines.append(
+                "  slo"
+                + (f" (target {target:.3f}s):" if target else ":")
+                + f" {win_bits}   breaches: {int(slo['breaches'])}   "
+                f"alerts: {int(slo['alerts'])}"
+            )
         bits = []
         if sv["drains"]:
             bits.append(f"drains: {int(sv['drains'])}")
@@ -583,3 +647,185 @@ def report_run(run_dir: str) -> dict[str, Any]:
         procs.sort()
         _merge_proc_reports(report, procs)
     return report
+
+
+# ---- postmortem bundles (obs/recorder.py) -----------------------------------
+
+# the ring-record bookkeeping keys; everything else in a record is a metric
+_RING_META_KEYS = ("step", "phase", "ts", "probe", "anomalies")
+
+
+def _verify_bundle(bundle_dir: str) -> tuple[bool, list[str]]:
+    """Inline sha256/size check against the bundle's ``manifest.json``.
+
+    Reimplements ``resilience.durable.verify_manifest`` on purpose: this
+    module must stay importable without jax, and ``resilience.__init__``
+    pulls jax in through the sentinel. Returns ``(verified, problems)`` —
+    no manifest is reported as unverified, not as an error (the bundle may
+    predate the manifest machinery or be mid-write)."""
+    mpath = os.path.join(bundle_dir, "manifest.json")
+    if not os.path.exists(mpath):
+        return False, ["no manifest.json (bundle unverifiable)"]
+    try:
+        with open(mpath, encoding="utf-8") as f:
+            files = json.load(f)["files"]
+    except (ValueError, KeyError, OSError) as e:
+        return False, [f"unreadable manifest: {e}"]
+    problems: list[str] = []
+    for name, meta in files.items():
+        fpath = os.path.join(bundle_dir, name)
+        if not os.path.exists(fpath):
+            problems.append(f"{name}: missing")
+            continue
+        size = os.path.getsize(fpath)
+        if size != int(meta["size"]):
+            problems.append(f"{name}: size {size} != {meta['size']}")
+            continue
+        h = hashlib.sha256()
+        with open(fpath, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != meta["sha256"]:
+            problems.append(f"{name}: sha256 mismatch")
+    return not problems, problems
+
+
+def load_postmortem(bundle_dir: str) -> dict[str, Any]:
+    """Load a flight-recorder postmortem bundle into a render-ready dict."""
+    meta_path = os.path.join(bundle_dir, "meta.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(
+            f"no meta.json under {bundle_dir!r} — is this a "
+            "flight-recorder postmortem bundle (obs/recorder.py)?"
+        )
+    verified, problems = _verify_bundle(bundle_dir)
+    with open(meta_path, encoding="utf-8") as f:
+        meta = json.load(f)
+    ring: list[dict] = []
+    ring_path = os.path.join(bundle_dir, "ring.jsonl")
+    if os.path.exists(ring_path):
+        with open(ring_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        ring.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn line of a crash-time dump
+    registry: dict = {}
+    reg_path = os.path.join(bundle_dir, "registry.json")
+    if os.path.exists(reg_path):
+        try:
+            with open(reg_path, encoding="utf-8") as f:
+                registry = json.load(f)
+        except ValueError:
+            pass
+    events_tail = 0
+    tail_path = os.path.join(bundle_dir, "events_tail.jsonl")
+    if os.path.exists(tail_path):
+        with open(tail_path, "rb") as f:
+            events_tail = sum(1 for line in f if line.strip())
+    return {
+        "bundle": bundle_dir,
+        "meta": meta,
+        "ring": ring,
+        "registry": registry,
+        "events_tail_lines": events_tail,
+        "verified": verified,
+        "problems": problems,
+    }
+
+
+def render_postmortem(pm: dict[str, Any]) -> str:
+    """Human rendering of :func:`load_postmortem`: the trip header, then the
+    ring as a step timeline with anomaly verdicts inline."""
+    meta = pm["meta"]
+    ring = pm["ring"]
+    lines: list[str] = []
+    lines.append(
+        f"postmortem: {meta.get('reason', '?')}   run: "
+        f"{meta.get('run', '?')}   bundle: {pm['bundle']}"
+    )
+    trip = {
+        k: v for k, v in meta.items()
+        if k not in ("schema", "reason", "run", "capacity", "steps",
+                     "dumped_ts")
+    }
+    if trip:
+        lines.append(
+            "trip: " + "   ".join(f"{k}={v}" for k, v in sorted(trip.items()))
+        )
+    if ring:
+        lines.append(
+            f"ring: {len(ring)} step(s) of {meta.get('capacity', '?')} "
+            f"(steps {ring[0]['step']}..{ring[-1]['step']})"
+        )
+    else:
+        lines.append("ring: empty (tripped before any recorded step)")
+    lines.append(
+        "integrity: "
+        + ("manifest verified (sha256)" if pm["verified"] else
+           "NOT verified — " + "; ".join(pm["problems"]))
+    )
+    counters = (pm.get("registry") or {}).get("counters", {})
+    anomaly_counts = {
+        k.rsplit(".", 1)[1]: v for k, v in counters.items()
+        if k.startswith("obs.anomaly.")
+    }
+    if anomaly_counts:
+        lines.append(
+            "anomalies (run totals): " + ", ".join(
+                f"{k}={int(v)}" for k, v in sorted(anomaly_counts.items())
+            )
+        )
+    if pm["events_tail_lines"]:
+        lines.append(f"events tail: {pm['events_tail_lines']} line(s)")
+    if not ring:
+        return "\n".join(lines)
+
+    # timeline: one row per ring record, the trip-relevant scalars first,
+    # anomaly verdicts flagged inline
+    lines.append("")
+    t0 = ring[0].get("ts")
+    hdr = (f"{'step':>6} {'phase':<4} {'t+s':>8} {'loss':>10} "
+           f"{'grad_norm':>10} {'reward':>8}  anomalies / extras")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+
+    def num(rec, *keys):
+        for k in keys:
+            v = rec.get(k)
+            if isinstance(v, (int, float)):
+                return v
+        return None
+
+    def cell(v, width, prec=4):
+        return f"{v:>{width}.{prec}g}" if v is not None else " " * width
+
+    for rec in ring:
+        dt = (rec["ts"] - t0) if (t0 is not None and "ts" in rec) else None
+        anomalies = rec.get("anomalies") or []
+        extras = []
+        ent = num(rec, "sample_entropy")
+        if ent is not None:
+            extras.append(f"entropy={ent:.2f}")
+        upd = num(rec, "upd_ratio/global")
+        if upd is not None:
+            extras.append(f"upd={upd:.2e}")
+        flag = (" <-- " + ",".join(anomalies)) if anomalies else ""
+        tail = "  ".join(extras)
+        lines.append(
+            f"{rec.get('step', '?'):>6} {rec.get('phase', ''):<4} "
+            f"{cell(dt, 8, 3)} {cell(num(rec, 'loss', 'rl_loss'), 10)} "
+            f"{cell(num(rec, 'grad_norm'), 10)} "
+            f"{cell(num(rec, 'reward_mean'), 8)}  {tail}{flag}"
+        )
+    probe = ring[-1].get("probe")
+    if probe:
+        lines.append("")
+        lines.append(
+            "last probe: " + "   ".join(
+                f"{k}={v:g}" for k, v in sorted(probe.items())
+            )
+        )
+    return "\n".join(lines)

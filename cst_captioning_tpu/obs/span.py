@@ -41,6 +41,15 @@ from cst_captioning_tpu.obs import metrics as _metrics
 _TLS = threading.local()
 
 
+def wall_time() -> float:
+    """Epoch-seconds "now" — the obs spelling for wall-clock timestamps.
+
+    Event streams, the flight recorder, and the JSONL event log all stamp
+    through here, so graftlint's GL010 ban on ad-hoc ``time.time()`` call
+    sites has exactly one sanctioned home."""
+    return time.time()  # graftlint: disable=GL010 (the single sanctioned wall-clock read)
+
+
 def _ctx() -> dict:
     d = getattr(_TLS, "ctx", None)
     if d is None:
@@ -171,7 +180,7 @@ class ObsRecorder:
     # ---- event stream -------------------------------------------------------
 
     def emit(self, event: str, **fields: Any) -> None:
-        rec = {"ts": time.time(), "event": event, **_ctx(), **fields}  # graftlint: disable=GL010 (the event stream's own wall-clock timestamp)
+        rec = {"ts": wall_time(), "event": event, **_ctx(), **fields}
         with self._lock:
             if self._closed:
                 return

@@ -159,7 +159,8 @@ def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
 def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
                     label_smoothing: float = 0.0, data_axis: str = "",
                     seq_axis: str = "seq", donate: bool = False,
-                    guard: bool = False, comm=None) -> Callable:
+                    guard: bool = False, comm=None,
+                    stats: bool = False) -> Callable:
     """Jitted SP (optionally DP x SP) XE train step.
 
     The loss is computed inside shard_map (loss psum'd over ``data_axis``
@@ -171,6 +172,10 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
     collective transposes already yield global grads, so there is no grad
     allreduce to bucket, compress, or overlap (ExperimentConfig rejects
     bf16/overlap knobs on the seq-parallel path for the same reason).
+
+    ``stats=True`` adds the flight recorder's per-family update-ratio
+    metrics (train/steps._update_ratios) — extra outputs only, params
+    bit-identical.
     """
     del comm  # no grad allreduce on this path — see docstring
     f_spec, m_spec = sp_batch_specs(model.cfg, data_axis, seq_axis)
@@ -216,7 +221,7 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
-        return _apply(state, grads, loss, gnorm, guard)
+        return _apply(state, grads, loss, gnorm, guard, stats=stats)
 
     return step
 
@@ -224,7 +229,7 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
 def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
                       seq_axis: str = "seq", chunks: int = 1,
                       donate: bool = False, guard: bool = False,
-                      comm=None) -> Callable:
+                      comm=None, stats: bool = False) -> Callable:
     """Jitted DP x SP REINFORCE update (the SCST update on a 2-D mesh).
 
     Same structure as :func:`make_sp_xe_step`: the (numerator, denominator)
@@ -372,7 +377,8 @@ def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
-        return _apply(state, grads, loss, gnorm, guard, key="rl_loss")
+        return _apply(state, grads, loss, gnorm, guard, key="rl_loss",
+                      stats=stats)
 
     return update
 

@@ -170,6 +170,15 @@ class FaultPlan:
                 # report shows exactly what was injected
                 obs_metrics.counter("resilience.chaos_fault").inc()
                 obs_metrics.counter(f"resilience.chaos_fault.{f.kind}").inc()
+        if due:
+            # flight-recorder postmortem BEFORE the fault fires: a kill or
+            # preempt unwinds past any later dump site. Lazy import keeps
+            # this module importable from jax-free contexts; the recorder's
+            # module import is jax-free by design (obs/recorder.py)
+            from cst_captioning_tpu.obs import recorder as obs_recorder
+
+            for f in due:
+                obs_recorder.note_fault(point, f.kind, visit=idx)
         # fire outside the lock: handlers/sleeps must not serialize threads
         for f in due:
             if f.kind == "kill":

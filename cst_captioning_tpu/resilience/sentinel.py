@@ -32,7 +32,9 @@ from typing import Any, Callable
 
 import jax
 
+from cst_captioning_tpu.obs import anomaly as obs_anomaly
 from cst_captioning_tpu.obs import metrics as obs_metrics
+from cst_captioning_tpu.obs import recorder as obs_recorder
 
 POLICIES = ("off", "skip_batch", "rollback", "abort")
 
@@ -120,6 +122,19 @@ class DivergenceSentinel:
         # when the per-event log rotated away (obs satellite: log-only ->
         # counted)
         obs_metrics.counter(f"resilience.divergence.{kind}").inc()
+        # the sentinel's verdict and the online detector (obs/anomaly.py)
+        # share ONE spelling: the same obs.anomaly.<kind> counter + anomaly
+        # event, whoever saw it first — dashboards and the postmortem
+        # timeline never disagree on what a divergence is called
+        obs_anomaly.record_anomaly(
+            kind, phase=self.phase, step=step, value=loss, source="sentinel"
+        )
+        # flight-recorder postmortem: capture the ring around the diverged
+        # step before any policy action (rollback restore, abort unwind)
+        obs_recorder.postmortem(
+            f"divergence_{kind}", phase=self.phase, step=step, loss=loss,
+            action=action,
+        )
         self.log(
             "divergence",
             phase=self.phase, step=step, loss=loss, kind=kind, action=action,

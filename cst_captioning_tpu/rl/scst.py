@@ -94,6 +94,21 @@ def compaction_stats(greedy_np, samples_np, stride: int, budget: int,
     }
 
 
+def sample_entropy(samples_np) -> float:
+    """Empirical token-distribution entropy (nats) of the sampled lanes,
+    from the already-on-host tokens — the flight recorder's entropy-collapse
+    signal (a policy converging onto a few captions drives this toward 0
+    while the reward mean can still look healthy). Pad tokens are excluded
+    so short captions don't masquerade as low entropy."""
+    toks = np.asarray(samples_np).ravel()
+    toks = toks[toks != PAD_ID]
+    if toks.size == 0:
+        return 0.0
+    counts = np.bincount(toks)
+    p = counts[counts > 0] / toks.size
+    return float(-(p * np.log(p)).sum())
+
+
 def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
                    max_len: int | None = None,
                    with_greedy: bool = True, fused: bool = True) -> Callable:
@@ -380,7 +395,8 @@ def _chunked_loss_grads(model, params, feats, masks, samples, advantage,
 
 
 def make_rl_update(model, chunks: int = 1, donate: bool = False,
-                   guard: bool = False, comm=None) -> Callable:
+                   guard: bool = False, comm=None,
+                   stats: bool = False) -> Callable:
     """Jitted: (state, feats, masks, samples [K,B,T], adv [K,B]) -> (state, metrics).
 
     ``chunks > 1`` accumulates gradients over slices of the rollout axis
@@ -392,6 +408,9 @@ def make_rl_update(model, chunks: int = 1, donate: bool = False,
     suppresses non-finite updates on device (resilience/guard.py) and adds
     a ``nonfinite`` metric. ``comm`` (parallel/comms.CommConfig) is accepted
     for factory-signature symmetry and ignored: no collectives here.
+    ``stats=True`` adds the flight recorder's per-family update-ratio
+    metrics (train/steps._update_ratios) — extra outputs only, params
+    bit-identical.
     """
     del comm  # no cross-device reduction on this path
 
@@ -422,16 +441,19 @@ def make_rl_update(model, chunks: int = 1, donate: bool = False,
 
             loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
-        return _apply(state, grads, loss, gnorm, guard, key="rl_loss")
+        return _apply(state, grads, loss, gnorm, guard, key="rl_loss",
+                      stats=stats)
 
     return update
 
 
 def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
                             chunks: int = 1, donate: bool = False,
-                            guard: bool = False, comm=None) -> Callable:
+                            guard: bool = False, comm=None,
+                            stats: bool = False) -> Callable:
     """shard_map variant: batch axis sharded, exact global normalization.
-    ``chunks`` / ``donate`` / ``guard`` exactly like :func:`make_rl_update`.
+    ``chunks`` / ``donate`` / ``guard`` / ``stats`` exactly like
+    :func:`make_rl_update`.
 
     ``comm`` (parallel/comms.CommConfig) selects the grad-allreduce
     spelling: None keeps the original per-leaf psum; otherwise bucketed
@@ -482,7 +504,8 @@ def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
         gnorm = optax.global_norm(grads)
         # psum'd grads/loss are device-invariant: the guarded select picks
         # the same branch on every shard, so state stays replicated
-        return _apply(state, grads, loss, gnorm, guard, key="rl_loss")
+        return _apply(state, grads, loss, gnorm, guard, key="rl_loss",
+                      stats=stats)
 
     sharded = shard_map(
         device_update,
@@ -519,6 +542,7 @@ class SCSTTrainer:
         retry: RetryPolicy | None = None,
         on_event: Callable | None = None,
         comm=None,
+        stats: bool = False,
     ):
         """``donate=True`` makes the REINFORCE update consume its input state
         (buffer donation — see :func:`make_rl_update`); the production
@@ -529,7 +553,9 @@ class SCSTTrainer:
         ``reward_retry`` events (an EventLogger.log works as-is).
         ``comm`` (parallel/comms.CommConfig) selects the update's grad
         allreduce spelling (None = original per-leaf psum); the Trainer
-        builds it from the ``train.comm_*`` knobs."""
+        builds it from the ``train.comm_*`` knobs. ``stats=True`` builds
+        the update with the flight recorder's per-family update-ratio
+        outputs (train/steps._update_ratios)."""
         self.model = model
         self.reward = reward
         self.cfg = cfg
@@ -573,6 +599,9 @@ class SCSTTrainer:
         # whole-update FLOPs from the compiled program
         self._update_cost = None
         obs.gauge("rl.decode.budget").set(float(self._depth_budget))
+        # decode FLOPs are always the analytic per-clip model (the early-exit
+        # loop's realized cost isn't a fixed compiled number)
+        obs.gauge("flops.backend.rl.decode").set(0.0)
         # only the 'greedy' baseline consumes the greedy rollout: scb/none
         # skip its decode, host transfer, and reward scoring entirely (one
         # of the K+1 decoded rows per clip on the flagship config)
@@ -591,7 +620,7 @@ class SCSTTrainer:
             )
             self.update = make_sp_rl_update(
                 spm, mesh, chunks=cfg.update_chunks, donate=donate,
-                guard=guard, comm=comm,
+                guard=guard, comm=comm, stats=stats,
             )
         elif mesh is not None:
             self.decode = make_parallel_rl_decode(
@@ -600,7 +629,7 @@ class SCSTTrainer:
             )
             self.update = make_parallel_rl_update(
                 model, mesh, chunks=cfg.update_chunks, donate=donate,
-                guard=guard, comm=comm,
+                guard=guard, comm=comm, stats=stats,
             )
         else:
             self.decode = make_rl_decode(
@@ -609,7 +638,7 @@ class SCSTTrainer:
             )
             self.update = make_rl_update(
                 model, chunks=cfg.update_chunks, donate=donate, guard=guard,
-                comm=comm,
+                comm=comm, stats=stats,
             )
 
     # ---- reward / advantage (host) ------------------------------------------
@@ -655,13 +684,24 @@ class SCSTTrainer:
         advantage = (r_kb - baseline) * valid_np[None, :]
         n_valid = max(valid_np.sum(), 1.0)
         v = valid_np[None, :]
+        r_valid = r_kb[:, valid_np > 0]
+        a_valid = advantage[:, valid_np > 0]
+        has_valid = valid_np.sum() > 0
         metrics = {
             "reward_mean": float((r_kb * v).sum() / (K * n_valid)),
-            "reward_std": (
-                float(r_kb[:, valid_np > 0].std()) if valid_np.sum() > 0 else 0.0
+            "reward_std": float(r_valid.std()) if has_valid else 0.0,
+            # reward tails (flight recorder): collapse shows up as p90
+            # pinning to p10 long before the mean moves
+            "reward_p10": (
+                float(np.percentile(r_valid, 10.0)) if has_valid else 0.0
+            ),
+            "reward_p90": (
+                float(np.percentile(r_valid, 90.0)) if has_valid else 0.0
             ),
             "baseline_mean": float((np.asarray(baseline) * v).sum() / (K * n_valid)),
             "advantage_mean": float(advantage.sum() / (K * n_valid)),
+            # advantage spread — the REINFORCE gradient's variance driver
+            "advantage_std": float(a_valid.std()) if has_valid else 0.0,
             # rows behind reward_mean: lets epoch/cross-host aggregation weight
             # steps exactly (wrap-padded final batches have fewer valid rows)
             "valid_rows": float(valid_np.sum()),
@@ -693,10 +733,12 @@ class SCSTTrainer:
                 greedy_np = multihost.to_host_local(
                     greedy, self.mesh, P("data")
                 ) if self.mesh is not None else np.asarray(greedy)
-            self._observe_decode(greedy_np, samples_np)
+            entropy = self._observe_decode(greedy_np, samples_np)
             advantage, host_metrics = self._advantage(
                 greedy_np, samples_np, video_ids, valid_np
             )
+            if entropy is not None:
+                host_metrics["sample_entropy"] = entropy
         return (advantage, host_metrics, samples, feats, masks, valid_np)
 
     # depth buckets sized to caption-length budgets (T <= ~64), not the
@@ -704,19 +746,21 @@ class SCSTTrainer:
     _DEPTH_BUCKETS = (2.0, 4.0, 6.0, 8.0, 10.0, 12.0, 16.0, 20.0, 24.0,
                       28.0, 32.0, 40.0, 48.0, 64.0)
 
-    def _observe_decode(self, greedy_np, samples_np) -> None:
+    def _observe_decode(self, greedy_np, samples_np) -> float | None:
         """Decode accounting from the already-on-host tokens: the analytic
         FLOPs counter behind the report's MFU column, the early-exit depth
         histogram (scan steps the while loop actually ran vs the T budget),
         and the ``rl.decode.compaction`` counter pair — (lane, column)
         steps the compacted driver computed vs skipped (what finished-lane
         compaction saves per batch; ``cli.obs_report`` surfaces the pair).
-        All derived from this process's local rows; no device reads."""
+        All derived from this process's local rows; no device reads.
+        Returns the sampled-lane entropy (:func:`sample_entropy`) for the
+        flight recorder's step record, or None when obs is off."""
         obs.counter("flops.rl.decode").inc(
             samples_np.shape[1] * self._decode_flops_per_clip
         )
         if not obs.enabled():
-            return
+            return None
         # rows finish at their (EOS-inclusive) length; the loop checks the
         # exit every `stride` steps, so it runs to the next stride multiple
         # of the longest row, capped at the padded budget
@@ -733,6 +777,7 @@ class SCSTTrainer:
         obs.counter("rl.decode.compaction.lanes_skipped").inc(
             stats["lanes_skipped"]
         )
+        return sample_entropy(samples_np)
 
     def _update_flops_inc(self, n_rows, args) -> float:
         """Per-process FLOPs to count for one update dispatch. Prefers the
@@ -748,6 +793,13 @@ class SCSTTrainer:
         if self._update_cost is None and obs.enabled():
             cost = _flops.compiled_cost(self.update, *args)
             self._update_cost = cost["flops"] if cost else False
+            # probe ledger: the degraded-mesh continuation rebuilds this
+            # trainer and must re-probe (tested); the backend gauge labels
+            # the report's MFU rows compiled-vs-analytic
+            obs.counter("obs.flops.probes").inc()
+            obs.gauge("flops.backend.rl.update").set(
+                1.0 if self._update_cost else 0.0
+            )
         if self._update_cost:
             return self._update_cost / jax.process_count()
         return n_rows * self._update_flops_per_clip

@@ -67,6 +67,7 @@ from cst_captioning_tpu.decoding.common import (
 )
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
 from cst_captioning_tpu import obs
+from cst_captioning_tpu.obs import anomaly as obs_anomaly
 from cst_captioning_tpu.obs.flops import enc_and_per_tok_flops
 from cst_captioning_tpu.resilience import chaos
 from cst_captioning_tpu.resilience.preempt import PreemptionHandler
@@ -126,6 +127,94 @@ class _Ticket:
     t_encoded: float = 0.0
 
 
+class SloMonitor:
+    """Rolling-window SLO attainment + multi-window burn-rate alerting.
+
+    One completion at a time: ``observe(latency_s, now)`` marks the request
+    ok iff ``latency_s <= target_s``, then for every rolling window (default
+    1-min fast / 10-min slow) computes
+
+    - attainment  = ok / total over the window,
+    - burn rate   = (1 - attainment) / (1 - objective) — how many times
+      faster than sustainable the error budget is burning (1.0 = exactly
+      on budget, 14.4 = a 30-day budget gone in ~2 days),
+
+    published as ``serving.slo.attainment.<w>s`` / ``serving.slo.burn_rate.
+    <w>s`` gauges. An alert trips only when the FAST window burns above
+    ``fast_burn`` AND the SLOW window above ``slow_burn`` (the classic
+    multi-window rule: the slow window filters blips, the fast window makes
+    the page recent) — edge-triggered into the ``serving.slo.alerts``
+    counter and the shared ``obs.anomaly.slo_burn`` spelling
+    (obs/anomaly.py), so the serving report and the training postmortem
+    timeline name SLO pain the same way. ``now`` comes from the service's
+    injectable clock: tests drive the windows with a fake clock."""
+
+    def __init__(
+        self,
+        target_s: float,
+        objective: float = 0.99,
+        windows: tuple[float, float] = (60.0, 600.0),
+        fast_burn: float = 14.4,
+        slow_burn: float = 6.0,
+    ):
+        if target_s <= 0:
+            raise ValueError(f"slo target_s {target_s} must be > 0")
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"slo objective {objective} must be in (0, 1)")
+        if len(windows) != 2 or windows[0] >= windows[1]:
+            raise ValueError(
+                f"slo windows {windows} must be (fast, slow) with fast < slow"
+            )
+        self.target_s = float(target_s)
+        self.objective = float(objective)
+        self.windows = tuple(float(w) for w in windows)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self._samples: dict[float, deque] = {
+            w: deque() for w in self.windows
+        }
+        self._alerting = False
+        self.alerts = 0
+
+    def burn_rate(self, window: float, now: float) -> float:
+        """Current burn rate over ``window`` (0.0 when no samples)."""
+        dq = self._samples[window]
+        while dq and dq[0][0] < now - window:
+            dq.popleft()
+        if not dq:
+            return 0.0
+        att = sum(ok for _, ok in dq) / len(dq)
+        return (1.0 - att) / (1.0 - self.objective)
+
+    def observe(self, latency_s: float, now: float) -> None:
+        ok = latency_s <= self.target_s
+        if not ok:
+            obs.counter("serving.slo.breaches").inc()
+        burns = {}
+        for w in self.windows:
+            dq = self._samples[w]
+            dq.append((now, ok))
+            while dq and dq[0][0] < now - w:
+                dq.popleft()
+            att = sum(o for _, o in dq) / len(dq)
+            burns[w] = (1.0 - att) / (1.0 - self.objective)
+            obs.gauge(f"serving.slo.attainment.{int(w)}s").set(att)
+            obs.gauge(f"serving.slo.burn_rate.{int(w)}s").set(burns[w])
+        fast, slow = self.windows
+        firing = burns[fast] >= self.fast_burn and burns[slow] >= self.slow_burn
+        if firing and not self._alerting:
+            # edge-triggered: one alert per excursion, not one per request
+            self.alerts += 1
+            obs.counter("serving.slo.alerts").inc()
+            obs_anomaly.record_anomaly(
+                "slo_burn",
+                target_s=self.target_s,
+                fast_burn=burns[fast],
+                slow_burn=burns[slow],
+            )
+        self._alerting = firing
+
+
 # the active service (drain target of the serving_preempt chaos fault and
 # the module-level request_drain() entry point)
 _ACTIVE: "CaptionService | None" = None
@@ -175,6 +264,10 @@ class CaptionService:
         kernel_block_b: int = 1,
         admit_group: int = 1,
         clock: Callable[[], float] = time.monotonic,
+        slo_target_s: float = 0.0,
+        slo_objective: float = 0.99,
+        slo_fast_burn: float = 14.4,
+        slo_slow_burn: float = 6.0,
     ):
         cfg = model.cfg
         self.model = model
@@ -258,6 +351,17 @@ class CaptionService:
         self._key_fn = jax.jit(
             lambda s: jax.random.key_data(jax.random.key(s))
         )
+        # SLO burn-rate monitor (SloMonitor docstring): off until a target
+        # exists (slo_target_s=0.0 default, or set_slo after calibration)
+        self._slo_kw = dict(
+            objective=slo_objective,
+            fast_burn=slo_fast_burn,
+            slow_burn=slo_slow_burn,
+        )
+        self._slo: SloMonitor | None = (
+            SloMonitor(slo_target_s, **self._slo_kw)
+            if slo_target_s > 0 else None
+        )
         # analytic per-token / encode FLOPs for the obs MFU counters
         feat_dims = tuple(d for _, d in cfg.modalities)
         self._enc_flops, self._tok_flops = enc_and_per_tok_flops(
@@ -295,6 +399,33 @@ class CaptionService:
     @property
     def draining(self) -> bool:
         return self._drain.is_set()
+
+    def set_slo(self, target_s: float) -> None:
+        """(Re)arm the SLO monitor with a latency target — the bench calls
+        this after calibrating a target from solo-request latency. Window
+        history restarts; ``target_s <= 0`` disarms."""
+        self._slo = (
+            SloMonitor(target_s, **self._slo_kw) if target_s > 0 else None
+        )
+        if self._slo is not None:
+            obs.gauge("serving.slo.target_s").set(float(target_s))
+
+    def slo_snapshot(self) -> dict | None:
+        """Current SLO-monitor state for reports (``None`` when disarmed):
+        target, objective, and per-window burn rate as of now."""
+        mon = self._slo
+        if mon is None:
+            return None
+        now = self.clock()
+        return {
+            "target_s": mon.target_s,
+            "objective": mon.objective,
+            "burn_rate": {
+                f"{int(w)}s": round(mon.burn_rate(w, now), 4)
+                for w in mon.windows
+            },
+            "breach_alerts": mon.alerts,
+        }
 
     def serve(
         self,
@@ -755,6 +886,10 @@ class CaptionService:
         )
         obs.histogram("serving.detok_seconds").observe(detok_s)
         obs.histogram("serving.latency_seconds").observe(latency)
+        if self._slo is not None:
+            # t_done is the service's monotone clock (injectable): the SLO
+            # windows slide on the same timeline the latencies came from
+            self._slo.observe(latency, t_done)
         obs.event(
             "serving_request", req=ticket.req.req_id, latency_s=latency,
             best_lane=best, steps=ticket.t, **{

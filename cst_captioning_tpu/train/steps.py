@@ -34,15 +34,48 @@ from cst_captioning_tpu.resilience.guard import guarded_apply_gradients
 from cst_captioning_tpu.train.state import TrainState
 
 
-def _apply(state, grads, loss, gnorm, guard: bool, key: str = "loss"):
+def _update_ratios(old_params, new_params) -> dict:
+    """Per-family relative update magnitude, computed on device.
+
+    For each top-level parameter family ``fam`` (the module groups under
+    ``params``): ``upd_ratio/<fam> = ||new - old|| / max(||old||, eps)``,
+    plus the all-params ``upd_ratio/global``. The classic LR-health signal:
+    a healthy Adam step sits around 1e-3; a family pinned at ~0 is frozen,
+    one at ~1 is being rewritten every step. Flight-recorder food — only
+    traced when a step factory is built with ``stats=True``."""
+    op = old_params.get("params", old_params)
+    np_ = new_params.get("params", new_params)
+
+    def ratio(o, n):
+        delta = optax.global_norm(jax.tree.map(lambda a, b: b - a, o, n))
+        return delta / jnp.maximum(optax.global_norm(o), 1e-12)
+
+    out = {f"upd_ratio/{fam}": ratio(op[fam], np_[fam]) for fam in op}
+    out["upd_ratio/global"] = ratio(op, np_)
+    return out
+
+
+def _apply(state, grads, loss, gnorm, guard: bool, key: str = "loss",
+           stats: bool = False):
     """Optionally-guarded update; metrics grow a ``nonfinite`` flag when
     guarded (see resilience/guard.py — bit-identical on finite steps).
     ``key`` names the loss metric ("loss" for XE steps, "rl_loss" for the
-    REINFORCE updates)."""
+    REINFORCE updates). ``stats=True`` (flight recorder on) additionally
+    returns the per-family update ratios (:func:`_update_ratios`) — extra
+    metric outputs only; the parameter math is untouched, and the default
+    ``stats=False`` program is literally the pre-stats one."""
+    old_params = state.params if stats else None
     if not guard:
-        return state.apply_gradients(grads), {key: loss, "grad_norm": gnorm}
-    state, nonfinite = guarded_apply_gradients(state, grads, loss, gnorm)
-    return state, {key: loss, "grad_norm": gnorm, "nonfinite": nonfinite}
+        new_state = state.apply_gradients(grads)
+        metrics = {key: loss, "grad_norm": gnorm}
+    else:
+        new_state, nonfinite = guarded_apply_gradients(
+            state, grads, loss, gnorm
+        )
+        metrics = {key: loss, "grad_norm": gnorm, "nonfinite": nonfinite}
+    if stats:
+        metrics.update(_update_ratios(old_params, new_state.params))
+    return new_state, metrics
 
 
 def _local_loss_sums(model, params, feats, masks, labels, mask, weights,
@@ -62,7 +95,7 @@ def _local_loss_sums(model, params, feats, masks, labels, mask, weights,
 
 
 def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False,
-                 guard: bool = False, comm=None):
+                 guard: bool = False, comm=None, stats: bool = False):
     """Single-device jitted step: (state, batch arrays) -> (state, metrics).
 
     ``donate=True`` donates the input ``state`` buffers to the output state
@@ -77,6 +110,11 @@ def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False,
 
     ``comm`` (parallel/comms.CommConfig) is accepted for factory-signature
     symmetry and ignored: the single-device step has no collectives.
+
+    ``stats=True`` adds the flight recorder's per-family update-ratio
+    metrics (:func:`_update_ratios`) — pure extra outputs, bit-identical
+    params; note the old params stay live past the update, so the param
+    buffers can't be donation-reused on stats builds.
     """
     del comm  # no cross-device reduction on this path
 
@@ -92,16 +130,19 @@ def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False,
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         gnorm = optax.global_norm(grads)
-        return _apply(state, grads, loss, gnorm, guard)
+        return _apply(state, grads, loss, gnorm, guard, stats=stats)
 
     return step
 
 
 def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
                           axis: str = "data", donate: bool = False,
-                          guard: bool = False, comm=None):
+                          guard: bool = False, comm=None,
+                          stats: bool = False):
     """shard_map data-parallel step, exact-equivalent to the fused batch.
-    ``donate`` / ``guard``: see :func:`make_xe_step`.
+    ``donate`` / ``guard`` / ``stats``: see :func:`make_xe_step`. The stats
+    ratios are computed from psum'd (device-invariant) grads, so they stay
+    replicated like the state.
 
     ``comm`` (parallel/comms.CommConfig) selects the grad-allreduce spelling:
     None keeps the original per-leaf psum; otherwise the reduction buckets
@@ -136,7 +177,7 @@ def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
         gnorm = optax.global_norm(grads)
         # grads/loss are psum'd (device-invariant), so the guard's where()
         # selects identically on every shard — state stays replicated
-        return _apply(state, grads, loss, gnorm, guard)
+        return _apply(state, grads, loss, gnorm, guard, stats=stats)
 
     sharded = shard_map(
         device_step,

@@ -41,7 +41,9 @@ import numpy as np
 from jax.sharding import Mesh
 
 from cst_captioning_tpu import obs
+from cst_captioning_tpu.obs import anomaly as _anomaly
 from cst_captioning_tpu.obs import flops as _flops
+from cst_captioning_tpu.obs import recorder as flight
 from cst_captioning_tpu.ckpt import CheckpointManager, load_params
 from cst_captioning_tpu.config.config import EvalConfig, ExperimentConfig
 from cst_captioning_tpu.data.batcher import Batcher
@@ -98,7 +100,9 @@ _VOLATILE_CONFIG_FIELDS = frozenset({
     "train.peer_timeout_s", "train.health_misses", "train.health_sim_hosts",
     "train.dcn_stall_s",
     # observability plumbing: where the spans/metrics go, not what runs
-    "train.obs", "train.obs_dir",
+    # (recorder/anomaly add metric OUTPUTS only — params stay bit-identical,
+    # see train/steps._apply — so they are resume-volatile like obs itself)
+    "train.obs", "train.obs_dir", "train.recorder_steps", "train.anomaly",
     "eval.results_json",
 })
 
@@ -157,6 +161,22 @@ class Trainer:
             # device kind — same table bench.py carries in its JSON)
             obs.gauge("device.peak_flops").set(
                 _flops.peak_flops(jax.devices()[0].device_kind)
+            )
+        # flight recorder (obs/recorder.py): per-step training-dynamics ring
+        # + postmortem bundles. stats=True threads the extra on-device
+        # update-ratio outputs through every step factory; the params math is
+        # bit-identical either way (train/steps._apply), and recorder_steps=0
+        # (default) builds literally the pre-recorder programs
+        self._stats = bool(cfg.train.obs and cfg.train.recorder_steps > 0)
+        if self._stats:
+            flight.configure(
+                cfg.train.recorder_steps,
+                obs_dir,
+                run=cfg.name,
+                detector=(
+                    _anomaly.AnomalyDetector() if cfg.train.anomaly else None
+                ),
+                config=cfg.to_dict(),
             )
         # everything below (state init, resume restore, first collate) is
         # run setup: give it a span so the report's phase totals account for
@@ -298,17 +318,18 @@ class Trainer:
                 self.xe_step = make_sp_xe_step(
                     sp_model(cfg.model), self.mesh, cfg.train.label_smoothing,
                     data_axis="data", donate=True, guard=self.guard,
-                    comm=comm,
+                    comm=comm, stats=self._stats,
                 )
             else:
                 self.xe_step = make_parallel_xe_step(
                     self.model, self.mesh, cfg.train.label_smoothing,
                     donate=True, guard=self.guard, comm=comm,
+                    stats=self._stats,
                 )
         else:
             self.xe_step = make_xe_step(
                 self.model, cfg.train.label_smoothing, donate=True,
-                guard=self.guard, comm=comm,
+                guard=self.guard, comm=comm, stats=self._stats,
             )
 
     def _xe_flops_inc(self, rows, args) -> float:
@@ -323,6 +344,13 @@ class Trainer:
         if self._xe_cost is None and obs.enabled():
             cost = _flops.compiled_cost(self.xe_step, *args)
             self._xe_cost = cost["flops"] if cost else False
+            # probe bookkeeping: the counter ticks once per (re)compiled
+            # program — a degraded-mesh rebuild re-probes and ticks again —
+            # and the gauge labels which backend the MFU column reflects
+            obs.counter("obs.flops.probes").inc()
+            obs.gauge("flops.backend.xe.step").set(
+                1.0 if self._xe_cost else 0.0
+            )
         if self._xe_cost:
             return self._xe_cost / jax.process_count()
         return rows * self._xe_flops_per_row
@@ -343,10 +371,15 @@ class Trainer:
         )
 
     def close(self) -> None:
-        """Stop background machinery (the health watchdog). Safe to call
-        twice; the monitor thread is a daemon either way."""
+        """Stop background machinery (the health watchdog, the flight
+        recorder). Safe to call twice; the monitor thread is a daemon
+        either way."""
         if self.health is not None:
             self.health.stop()
+        if self._stats:
+            # orderly close: final flush, NO postmortem dump (crashes that
+            # skip close() still dump via the recorder's atexit hook)
+            flight.shutdown()
 
     # ---- resume / handoff --------------------------------------------------
 
@@ -604,6 +637,9 @@ class Trainer:
         an update the sentinel would have rejected), save mid-epoch, make the
         event log durable, and unwind via :class:`Preempted`."""
         sentinel.flush()
+        # postmortem before the unwind: the bundle captures the ring as of
+        # the drained step (postmortem self-flushes the recorder)
+        flight.postmortem("preempt", phase=phase, step=step_no)
         self._save_step_ckpt(phase, step_no, batch_index, seam=seam)
         self.log.log(
             "preempt", phase=phase, step=step_no, batch_index=batch_index,
@@ -624,6 +660,7 @@ class Trainer:
         drain-aware order, then :class:`PeerLost` so the caller picks
         degraded continuation or the strict full-restart fallback."""
         sentinel.flush()
+        flight.postmortem("peer_loss", phase=phase, step=step_no)
         self._save_step_ckpt(phase, step_no, batch_index, seam=seam)
         lost = self.health.lost()
         obs.counter("resilience.peer_loss_drain").inc()
@@ -647,6 +684,9 @@ class Trainer:
         sequence. Budgeted by ``train.max_rollbacks``."""
         self._rollbacks += 1
         obs.counter("resilience.rollback").inc()
+        # no postmortem here: the sentinel already dumped the ring at the
+        # divergence itself (reason=divergence_<kind>, action=rollback) —
+        # a second dump would hold the identical ring and burn dump budget
         if self._rollbacks > self.cfg.train.max_rollbacks:
             raise TrainingDiverged(
                 f"rollback budget exhausted ({self.cfg.train.max_rollbacks}) "
@@ -883,6 +923,11 @@ class Trainer:
                         # step (graftlint GL001); the epoch summary reads
                         # them all back in one device_get
                         losses.append(m["loss"])
+                        # record before push: a sentinel trip's postmortem
+                        # self-flushes, so the ring always includes the
+                        # diverged step (flight.record keeps device scalars
+                        # — zero sync, same contract as sentinel.push)
+                        flight.record(step_no + 1, "xe", m)
                         sentinel.push(step_no + 1, m["loss"], m.get("nonfinite"))
                         step_no += 1
                         batch_no += 1
@@ -901,6 +946,9 @@ class Trainer:
                                 loss=float(m["loss"]),
                                 grad_norm=float(m["grad_norm"]),
                             )
+                            # ride the same gate: ONE batched device_get
+                            # drains the recorder's pending scalars
+                            flight.flush()
                         obs.maybe_snapshot(step_no)
                         profiler.tick()
                         meter.tick(cfg.data.batch_size, first=run["first_step"])
@@ -925,6 +973,7 @@ class Trainer:
                             )
                         if ckpt_every and step_no % ckpt_every == 0:
                             # never save an update the policy rejects
+                            flight.flush()
                             sentinel.flush()
                             self._save_step_ckpt("xe", step_no, batch_no)
             finally:
@@ -936,6 +985,7 @@ class Trainer:
                 self._preempt_save("xe", step_no, batch_no, sentinel)
             if self.health is not None and self.health.peer_lost:
                 self._peer_loss_save("xe", step_no, batch_no, sentinel)
+            flight.flush()
             sentinel.flush()
         self.epoch += 1
         self.xe_epochs += 1
@@ -1024,6 +1074,7 @@ class Trainer:
                 max_len=cfg.model.max_len, donate=True, guard=self.guard,
                 on_event=self.log.log,
                 comm=CommConfig.from_train(cfg.train),
+                stats=self._stats,
             )
             rl_batcher = Batcher(
                 self.train_ds,
@@ -1129,6 +1180,11 @@ class Trainer:
             valid_rows.append(m["valid_rows"])
             step_counter["step"] += 1
             batch_counter["n"] += 1
+            # record before push (see _xe_epoch): the dict mixes device
+            # scalars (rl_loss, grad_norm, upd_ratio/*) with host floats
+            # (reward_*, advantage_*, sample_entropy) — the recorder's
+            # batched device_get handles both
+            flight.record(step_counter["step"], "rl", m)
             sentinel.push(
                 step_counter["step"], m["rl_loss"], m.get("nonfinite")
             )
@@ -1144,6 +1200,7 @@ class Trainer:
                     rl_loss=float(m["rl_loss"]),
                     grad_norm=float(m["grad_norm"]),
                 )
+                flight.flush()
             obs.maybe_snapshot(step_counter["step"])
             profiler.tick()
             meter.tick(cfg.data.batch_size, first=run["first_step"])
@@ -1193,6 +1250,7 @@ class Trainer:
                     "rl", step_counter["step"], batch_counter["n"], sentinel,
                     seam=seam_sink or None,
                 )
+            flight.flush()
             sentinel.flush()
         self.epoch += 1
         self.rl_epochs += 1

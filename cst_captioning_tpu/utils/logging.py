@@ -11,8 +11,9 @@ import atexit
 import json
 import os
 import sys
-import time
 from typing import Any, IO
+
+from cst_captioning_tpu.obs import wall_time
 
 
 class EventLogger:
@@ -37,7 +38,9 @@ class EventLogger:
         self.echo = echo
 
     def log(self, event: str, **fields: Any) -> None:
-        rec = {"ts": time.time(), "event": event, **fields}
+        # obs.wall_time is the one sanctioned wall-clock read (GL010): the
+        # JSONL log and the obs event stream stamp through the same spelling
+        rec = {"ts": wall_time(), "event": event, **fields}
         if self._fh:
             self._fh.write(json.dumps(rec, default=float) + "\n")
         if self.echo:
@@ -45,7 +48,7 @@ class EventLogger:
                 f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in fields.items()
             )
-            print(f"[{event}] {kv}", file=sys.stderr)
+            sys.stderr.write(f"[{event}] {kv}\n")
 
     def flush(self) -> None:
         """Push buffered events to the OS and fsync them to disk — called on

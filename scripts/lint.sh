@@ -38,6 +38,12 @@ python -m compileall -q cst_captioning_tpu tests scripts \
 # dead runs — it has to work on a box with nothing but the repo)
 python -m cst_captioning_tpu.cli.obs_report tests/fixtures/obs_run > /dev/null
 
+# postmortem smoke: the flight-recorder bundle renderer (manifest verify +
+# ring timeline) against the committed fixture bundle — same no-jax
+# contract; dead-run triage must work anywhere
+python -m cst_captioning_tpu.cli.obs_report \
+    --postmortem tests/fixtures/postmortem_bundle > /dev/null
+
 # decode fast-path smoke: tiny-dims CPU run of all three decode impls
 # (two-loop / fused one-loop / Pallas kernel) with the fused-vs-two-loop
 # bit-exactness gate inside — keeps bench_decode.py and the kernel from

@@ -919,3 +919,107 @@ def test_report_no_serving_section_without_requests(tmp_path):
     rep = report_run(run)
     assert rep["serving"] is None
     assert "serving:" not in render_report(rep)
+
+
+# ---- Obs v2: prometheus specials, FLOPs-backend tags, serving SLO -----------
+
+
+def test_prometheus_nonfinite_and_cumulative_inf_bucket():
+    """_prom_num pins: gauges/counters holding NaN/±Inf render the Prometheus
+    spellings ("NaN"/"+Inf"/"-Inf" — repr would emit "nan" and break
+    scrapers), and the histogram's +Inf cumulative bucket always equals the
+    total count even when every observation overflows the bounds."""
+    reg = Registry()
+    reg.gauge("loss.last").set(float("nan"))
+    reg.gauge("burn.fast").set(float("inf"))
+    reg.gauge("burn.neg").set(float("-inf"))
+    reg.counter("secs").inc(1.5)
+    h = reg.histogram("lat", buckets=(0.1,))
+    h.observe(5.0)
+    h.observe(7.0)  # both overflow: finite buckets stay 0
+    lines = reg.to_prometheus().splitlines()
+    assert "loss_last NaN" in lines
+    assert "burn_fast +Inf" in lines
+    assert "burn_neg -Inf" in lines
+    assert "secs 1.5" in lines
+    assert 'lat_bucket{le="0.1"} 0' in lines
+    assert 'lat_bucket{le="+Inf"} 2' in lines
+    assert "lat_count 2" in lines
+
+
+def test_report_mfu_rows_carry_flops_backend(tmp_path):
+    """Obs v2 satellite: each phase row labels WHICH FLOPs source its mfu
+    reflects (compiled XLA cost vs the analytic model) — in the JSON field
+    and as the c/a mark + legend in the rendered table."""
+    run = str(tmp_path / "run")
+    events = [
+        {"ts": 0.0, "event": "run_start", "run": "b", "thread": "MainThread"},
+        {"ts": 1.0, "event": "span", "name": "xe.step", "dur": 1.0,
+         "self_dur": 1.0, "thread": "MainThread"},
+        {"ts": 3.0, "event": "span", "name": "rl.update", "dur": 1.0,
+         "self_dur": 1.0, "thread": "MainThread"},
+        {"ts": 5.0, "event": "span", "name": "rl.decode", "dur": 1.0,
+         "self_dur": 1.0, "thread": "MainThread"},
+        {"ts": 9.9, "event": "metrics",
+         "counters": {"flops.xe.step": 1e12, "flops.rl.update": 2e12,
+                      "flops.rl.decode": 3e12},
+         "gauges": {"device.peak_flops": 1e12,
+                    "flops.backend.xe.step": 1.0,     # compiled probe hit
+                    "flops.backend.rl.update": 0.0}}, # analytic fallback
+        {"ts": 10.0, "event": "run_end"},
+    ]
+    _write_stream(os.path.join(run, "events.jsonl"), events)
+    rep = report_run(run)
+    by_name = {p["phase"]: p for p in rep["phases"]}
+    assert by_name["xe.step"]["flops_backend"] == "compiled"
+    assert by_name["rl.update"]["flops_backend"] == "analytic"
+    assert by_name["rl.decode"]["flops_backend"] is None  # no gauge: untagged
+    text = render_report(rep)
+    assert "0.1000c" in text and "0.2000a" in text
+    assert "mfu flops source: c = compiled program" in text
+
+
+def test_report_serving_slo_section(tmp_path):
+    """The serving section surfaces the SLO monitor's per-window attainment/
+    burn-rate gauges, breach + alert counters, and the target."""
+    run = str(tmp_path / "run")
+    _write_stream(
+        os.path.join(run, "events.jsonl"),
+        _proc_events(0.0, 2.0, 0.5,
+                     counters={"serving.requests_submitted": 10,
+                               "serving.requests_admitted": 10,
+                               "serving.requests_completed": 10,
+                               "serving.strides": 4,
+                               "serving.slo.breaches": 3,
+                               "serving.slo.alerts": 1},
+                     gauges={"serving.slo.target_s": 0.25,
+                             "serving.slo.attainment.60s": 0.7,
+                             "serving.slo.burn_rate.60s": 30.0,
+                             "serving.slo.attainment.600s": 0.97,
+                             "serving.slo.burn_rate.600s": 3.0}),
+    )
+    rep = report_run(run)
+    slo = rep["serving"]["slo"]
+    assert slo["target_s"] == 0.25
+    assert slo["windows"][60]["attainment"] == pytest.approx(0.7)
+    assert slo["windows"][60]["burn_rate"] == pytest.approx(30.0)
+    assert slo["windows"][600]["burn_rate"] == pytest.approx(3.0)
+    assert slo["breaches"] == 3 and slo["alerts"] == 1
+    text = render_report(rep)
+    assert "slo (target 0.250s):" in text
+    assert "60s: 70.0% (burn 30.0x)" in text
+    assert "breaches: 3" in text and "alerts: 1" in text
+
+
+def test_report_serving_without_slo_has_no_slo_key(tmp_path):
+    run = str(tmp_path / "run")
+    _write_stream(
+        os.path.join(run, "events.jsonl"),
+        _proc_events(0.0, 1.0, 0.5,
+                     counters={"serving.requests_submitted": 1,
+                               "serving.requests_admitted": 1,
+                               "serving.requests_completed": 1}),
+    )
+    rep = report_run(run)
+    assert "slo" not in rep["serving"]
+    assert "slo" not in render_report(rep)

@@ -423,3 +423,84 @@ def test_serving_obs_events_and_report(setup, tmp_path):
     # engine-loop spans land in the phase table
     names = {p["phase"] for p in rep["phases"]}
     assert {"serving.stride", "serving.encode"} <= names
+
+
+# ---- SLO burn-rate monitor (Obs v2) -----------------------------------------
+
+
+def test_slo_monitor_burn_rates_and_edge_triggered_alerts():
+    """Multi-window burn-rate math on a fake clock: attainment/burn gauges,
+    the breach counter, and the edge-triggered alert (fires once per
+    excursion when BOTH windows burn hot; re-fires for a new excursion)."""
+    from cst_captioning_tpu.obs import metrics as obs_metrics
+    from cst_captioning_tpu.serving.engine import SloMonitor
+
+    obs_metrics.REGISTRY.reset()
+    mon = SloMonitor(0.1, objective=0.9, windows=(10.0, 100.0),
+                     fast_burn=2.0, slow_burn=1.5)
+    # 9 ok + 1 breach: attainment 0.9 == objective -> burning exactly at
+    # budget (1.0x), no alert
+    for i in range(9):
+        mon.observe(0.05, now=float(i))
+    mon.observe(0.5, now=9.0)
+    snap = obs_metrics.snapshot()
+    assert snap["gauges"]["serving.slo.attainment.10s"] == pytest.approx(0.9)
+    assert snap["gauges"]["serving.slo.burn_rate.10s"] == pytest.approx(1.0)
+    assert snap["counters"]["serving.slo.breaches"] == 1
+    assert mon.alerts == 0
+
+    # sustained breaches push BOTH windows over threshold: ONE alert for
+    # the excursion, counted through the shared anomaly spelling
+    for i in range(10, 16):
+        mon.observe(0.5, now=float(i))
+    snap = obs_metrics.snapshot()
+    assert mon.alerts == 1
+    assert snap["counters"]["serving.slo.alerts"] == 1
+    assert snap["counters"]["obs.anomaly.slo_burn"] == 1
+
+    # recovery clears the latch; a fresh excursion re-alerts
+    for i in range(16, 40):
+        mon.observe(0.01, now=float(i))
+    assert mon.alerts == 1
+    for i in range(40, 52):
+        mon.observe(0.5, now=float(i))
+    assert mon.alerts == 2
+
+    # window expiry: 200s of silence ages everything out of both windows
+    assert mon.burn_rate(10.0, now=260.0) == 0.0
+    assert mon.burn_rate(100.0, now=260.0) == 0.0
+
+
+def test_slo_monitor_validates_parameters():
+    from cst_captioning_tpu.serving.engine import SloMonitor
+
+    with pytest.raises(ValueError):
+        SloMonitor(0.0)
+    with pytest.raises(ValueError):
+        SloMonitor(0.1, objective=1.0)
+    with pytest.raises(ValueError):
+        SloMonitor(0.1, windows=(600.0, 60.0))  # fast must be < slow
+
+
+def test_service_set_slo_gauges_and_snapshot(setup):
+    """set_slo arms the monitor after calibration (bench_serving's flow):
+    served completions populate the target/attainment/burn gauges and
+    slo_snapshot(); target <= 0 disarms."""
+    from cst_captioning_tpu.obs import metrics as obs_metrics
+
+    model, params = setup
+    obs_metrics.REGISTRY.reset()
+    svc = CaptionService(model, params, capacity=2, num_rollouts=1, stride=4)
+    assert svc.slo_snapshot() is None  # disarmed by default
+    svc.set_slo(30.0)  # generous target: every request lands within
+    svc.serve(_requests(frames=(2, 8, 5)))
+    snap = obs_metrics.snapshot()
+    assert snap["gauges"]["serving.slo.target_s"] == 30.0
+    assert snap["gauges"]["serving.slo.attainment.60s"] == 1.0
+    assert snap["gauges"]["serving.slo.burn_rate.60s"] == 0.0
+    assert snap["counters"].get("serving.slo.breaches") is None
+    s = svc.slo_snapshot()
+    assert s["target_s"] == 30.0 and s["breach_alerts"] == 0
+    assert s["burn_rate"] == {"60s": 0.0, "600s": 0.0}
+    svc.set_slo(0.0)
+    assert svc.slo_snapshot() is None
