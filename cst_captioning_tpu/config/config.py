@@ -219,6 +219,22 @@ class TrainConfig:
                 f"recorder_steps {self.recorder_steps} must be >= 0 "
                 "(0 = flight recorder off)"
             )
+        if self.spike_mode not in ("fixed", "adaptive"):
+            raise ValueError(
+                f"unknown spike_mode {self.spike_mode!r} "
+                "(expected 'fixed' or 'adaptive')"
+            )
+        if self.spike_mode == "adaptive":
+            if self.spike_factor <= 0:
+                raise ValueError(
+                    "spike_mode='adaptive' needs spike_factor > 0 — the "
+                    "factor is the adaptive bound's ceiling clamp"
+                )
+            if not 0 < self.spike_factor_min <= self.spike_factor:
+                raise ValueError(
+                    f"spike_factor_min {self.spike_factor_min} must be in "
+                    f"(0, spike_factor={self.spike_factor}]"
+                )
     # per-step JSONL events (loss/reward + grad_norm every N steps; 0 = off,
     # keeping logs to per-epoch summaries)
     log_every_steps: int = 0
@@ -246,6 +262,13 @@ class TrainConfig:
     # loss-spike sentinel: flag a finite loss > factor * median(recent
     # window); 0 = NaN/inf detection only
     spike_factor: float = 0.0
+    # "fixed" = the factor-of-median bound above, untouched. "adaptive" =
+    # the anomaly detector's EWMA moments set the bound (mean + z*std,
+    # clamped to [spike_factor_min, spike_factor] x median — never looser
+    # than fixed; catches slow ramps the fixed factor misses). Requires
+    # spike_factor > 0; shares the detector's loss Ewma when `anomaly` is on
+    spike_mode: str = "fixed"
+    spike_factor_min: float = 1.5       # adaptive bound's floor clamp
     max_rollbacks: int = 2              # rollback budget per run before aborting
     # ---- elastic multi-host resilience (resilience/health.py; README
     # "Elastic training"): off by default — the hot loops then carry zero

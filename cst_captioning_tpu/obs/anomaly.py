@@ -103,6 +103,13 @@ class AnomalyDetector:
                       for s in self.STREAMS}
         self._gaps: deque[float] = deque(maxlen=gap_window)
 
+    def ewma(self, stream: str) -> Ewma:
+        """The live :class:`Ewma` behind ``stream`` — shared with
+        :class:`resilience.adaptive.AdaptiveThresholds` so the sentinel's
+        adaptive spike bound and the detector's z-scores read the *same*
+        moments instead of maintaining drifting copies."""
+        return self._ewma[stream]
+
     def observe(self, stream: str, value: float, *, step: int = -1,
                 phase: str = "") -> list[str]:
         """Judge one observation of ``stream``. Unknown streams are carried

@@ -43,6 +43,7 @@ import atexit
 import json
 import os
 import re
+import socket
 import sys
 import threading
 import time
@@ -73,6 +74,18 @@ _PROBE_COUNTERS = (
 
 _EVENTS_TAIL_LINES = 200
 
+# monotonic<->wall anchor pairs kept per recorder: one at start plus one per
+# flush, capped so a long run's meta.json stays small (the last slot keeps
+# sliding forward, so the newest anchor always brackets the newest records)
+_MAX_ANCHORS = 256
+
+# bundle meta schema history:
+#   1 — PR 12: reason/run/capacity/steps/dumped_ts
+#   2 — this PR: + proc/world/host identity and ``anchors`` (the fleet merge
+#       in obs/fleet.py uses them to put N rings on one corrected timeline;
+#       schema-1 bundles still merge, with ``skew="unknown"``)
+_META_SCHEMA = 2
+
 
 def _sanitize(reason: str) -> str:
     return re.sub(r"[^A-Za-z0-9_.-]+", "_", reason)[:64] or "unknown"
@@ -84,7 +97,8 @@ class FlightRecorder:
     def __init__(self, capacity: int, out_dir: str, run: str = "run",
                  detector=None, config: dict | None = None,
                  max_dumps: int = 4,
-                 probe: Callable[[], dict] | None = None):
+                 probe: Callable[[], dict] | None = None,
+                 proc: int = 0, world: int = 1, host: str = ""):
         if capacity < 1:
             raise ValueError(f"recorder capacity {capacity} must be >= 1")
         os.makedirs(out_dir, exist_ok=True)
@@ -94,6 +108,11 @@ class FlightRecorder:
         self.config = config or {}
         self.max_dumps = max_dumps
         self.probe = probe
+        # host identity: which process/host this ring belongs to, so the
+        # fleet merge can name hosts instead of bundle paths
+        self.proc = int(proc)
+        self.world = max(int(world), 1)
+        self.host = host or socket.gethostname()
         self.ring: deque[dict] = deque(maxlen=capacity)
         self._buf: list[tuple[int, str, Any, float]] = []
         self._lock = threading.Lock()
@@ -104,6 +123,11 @@ class FlightRecorder:
         # get absolute timestamps without a wall-clock read per step
         self._pc0 = time.perf_counter()
         self._wall0 = _wall_time()
+        # anchors pair the monotonic clock with the wall clock at start and
+        # at each flush; the fleet merge maps ring ``ts`` (derived from the
+        # start anchor alone) through the freshest bracket, so NTP steps or
+        # wall-clock drift during the run don't corrupt cross-host alignment
+        self._anchors: list[tuple[float, float]] = [(self._pc0, self._wall0)]
         self._atexit = self._crash_dump
         atexit.register(self._atexit)
 
@@ -127,7 +151,12 @@ class FlightRecorder:
 
         values = jax.device_get([scalars for _, _, scalars, _ in buf])
         probe = self._probe()
+        anchor = (time.perf_counter(), _wall_time())
         with self._lock:
+            if len(self._anchors) < _MAX_ANCHORS:
+                self._anchors.append(anchor)
+            else:
+                self._anchors[-1] = anchor
             for (step, phase, _, t), vals in zip(buf, values):
                 rec: dict[str, Any] = {
                     "step": int(step),
@@ -185,12 +214,16 @@ class FlightRecorder:
 
     # ---- postmortem bundles -------------------------------------------------
 
-    def postmortem(self, reason: str, **fields: Any) -> str | None:
+    def postmortem(self, reason: str, *,
+                   registry_extra: dict | None = None,
+                   **fields: Any) -> str | None:
         """Flush, then dump the ring + context as a durable bundle.
 
-        Returns the bundle directory, or ``None`` when the per-process dump
-        budget (``max_dumps``) is spent — a run stuck in a divergence loop
-        must not fill the disk with identical bundles."""
+        ``registry_extra`` merges extra top-level blocks into the bundle's
+        ``registry.json`` (the serving drain rides its SLO snapshot along
+        this way). Returns the bundle directory, or ``None`` when the
+        per-process dump budget (``max_dumps``) is spent — a run stuck in a
+        divergence loop must not fill the disk with identical bundles."""
         flush_error = ""
         try:
             self.flush()
@@ -200,10 +233,13 @@ class FlightRecorder:
             flush_error = f"{type(e).__name__}: {e}"
         with self._lock:
             if self._dumps >= self.max_dumps:
+                self._budget_gauge()
                 return None
             self._dumps += 1
             n = self._dumps
             ring = list(self.ring)
+            anchors = list(self._anchors)
+        self._budget_gauge()
         # lazy: resilience.__init__ pulls jax via the sentinel; only dump
         # paths (never import time) pay that
         from cst_captioning_tpu.resilience import durable
@@ -213,22 +249,29 @@ class FlightRecorder:
         tmp = final + ".tmp"
         os.makedirs(tmp, exist_ok=True)
         meta = {
-            "schema": 1,
+            "schema": _META_SCHEMA,
             "reason": reason,
             "run": self.run,
+            "proc": self.proc,
+            "world": self.world,
+            "host": self.host,
             "capacity": self.ring.maxlen,
             "steps": [r["step"] for r in ring],
+            "anchors": [[pc, wall] for pc, wall in anchors],
             "dumped_ts": _wall_time(),
             **fields,
         }
         if flush_error:
             meta["flush_error"] = flush_error
+        registry = _metrics.snapshot()
+        if registry_extra:
+            registry = {**registry, **registry_extra}
         blobs = {
             "ring.jsonl": "".join(
                 json.dumps(r, default=float) + "\n" for r in ring
             ).encode(),
             "registry.json": json.dumps(
-                _metrics.snapshot(), default=float, indent=2
+                registry, default=float, indent=2
             ).encode(),
             "events_tail.jsonl": self._events_tail(),
             "config.json": json.dumps(
@@ -247,6 +290,16 @@ class FlightRecorder:
         _span_event("postmortem", reason=reason, bundle=final,
                     steps=len(ring))
         return final
+
+    def _budget_gauge(self) -> None:
+        """Export the remaining dump budget — an exhausted budget means later
+        trips leave no bundle, which a dashboard should show *before* the
+        postmortem someone goes looking for turns out not to exist. Only the
+        process-global recorder owns the gauge; ephemeral recorders (serving
+        drains without obs configured) must not clobber it."""
+        if _FLIGHT is self:
+            left = max(self.max_dumps - self._dumps, 0)
+            _metrics.gauge("obs.recorder.dump_budget").set(float(left))
 
     def _events_tail(self) -> bytes:
         """Last lines of the live obs event stream (line-buffered on disk, so
@@ -295,13 +348,17 @@ _FLIGHT: FlightRecorder | None = None
 
 def configure(capacity: int, out_dir: str, run: str = "run", detector=None,
               config: dict | None = None, max_dumps: int = 4,
-              probe: Callable[[], dict] | None = None) -> FlightRecorder:
+              probe: Callable[[], dict] | None = None,
+              proc: int = 0, world: int = 1,
+              host: str = "") -> FlightRecorder:
     """Install the process-global flight recorder (closing any previous)."""
     global _FLIGHT
     if _FLIGHT is not None:
         _FLIGHT.close()
     _FLIGHT = FlightRecorder(capacity, out_dir, run=run, detector=detector,
-                             config=config, max_dumps=max_dumps, probe=probe)
+                             config=config, max_dumps=max_dumps, probe=probe,
+                             proc=proc, world=world, host=host)
+    _FLIGHT._budget_gauge()
     return _FLIGHT
 
 
@@ -330,17 +387,20 @@ def flush() -> None:
         fr.flush()
 
 
-def postmortem(reason: str, **fields: Any) -> str | None:
+def postmortem(reason: str, *, registry_extra: dict | None = None,
+               **fields: Any) -> str | None:
     fr = _FLIGHT
     if fr is not None:
-        return fr.postmortem(reason, **fields)
+        return fr.postmortem(reason, registry_extra=registry_extra, **fields)
     return None
 
 
-def note_fault(point: str, kind: str, visit: int) -> None:
+def note_fault(point: str, kind: str, visit: int, **fields: Any) -> None:
     """Chaos-harness hook (lazy-imported from resilience/chaos.py): an
     injected fault is a trip — capture the ring as it was when the fault
-    fired, before its consequences land."""
+    fired, before its consequences land. Extra ``fields`` (e.g. the victim
+    ``host`` of a ``partial_preempt``) ride into the bundle's meta so the
+    fleet merge can name the victim."""
     fr = _FLIGHT
     if fr is not None:
-        fr.postmortem(f"chaos_{kind}", point=point, visit=visit)
+        fr.postmortem(f"chaos_{kind}", point=point, visit=visit, **fields)

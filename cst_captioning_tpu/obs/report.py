@@ -742,18 +742,34 @@ def render_postmortem(pm: dict[str, Any]) -> str:
     meta = pm["meta"]
     ring = pm["ring"]
     lines: list[str] = []
+    # schema-2 bundles (obs/recorder.py) carry host identity; schema-1 ones
+    # predate it and render without the proc/host tag
+    ident = ""
+    if "proc" in meta:
+        ident = (
+            f"   proc: {meta['proc']}/{meta.get('world', '?')}"
+            f" ({meta.get('host', '?')})"
+        )
     lines.append(
         f"postmortem: {meta.get('reason', '?')}   run: "
-        f"{meta.get('run', '?')}   bundle: {pm['bundle']}"
+        f"{meta.get('run', '?')}{ident}   bundle: {pm['bundle']}"
     )
     trip = {
         k: v for k, v in meta.items()
         if k not in ("schema", "reason", "run", "capacity", "steps",
-                     "dumped_ts")
+                     "dumped_ts", "proc", "world", "host", "anchors",
+                     "flush_error")
     }
     if trip:
         lines.append(
             "trip: " + "   ".join(f"{k}={v}" for k, v in sorted(trip.items()))
+        )
+    if meta.get("flush_error"):
+        # the dump-time flush failing IS evidence (the ring predates the
+        # trip by one flush) — front and center, not buried in raw meta
+        lines.append(
+            f"FLUSH FAILED at dump time: {meta['flush_error']} — ring below "
+            "is stale by up to one flush interval"
         )
     if ring:
         lines.append(

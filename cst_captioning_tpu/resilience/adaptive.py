@@ -1,0 +1,116 @@
+"""Anomaly-adaptive sentinel thresholds: the detector's moments become the
+spike bound.
+
+The divergence sentinel's fixed policy — trip when
+``loss > spike_factor * median(recent)`` — needs a factor loose enough to
+survive healthy noise, which makes it blind to a *slow ramp*: a loss that
+creeps up a few percent per step drags the median along with it, so
+``loss / median`` never reaches the factor and the run burns hours before
+the nonfinite check finally fires. The anomaly detector already maintains
+exactly the statistic that catches this: an EWMA mean/variance of the loss
+stream whose memory (``~2/alpha`` steps) is long enough that early ramp
+steps sit many EW-standard-deviations above the healthy-phase mean *before*
+the moments re-converge.
+
+:class:`AdaptiveThresholds` maps those moments onto the sentinel's bound::
+
+    bound = clamp(mean + z * std,  spike_factor_min * median,
+                                   spike_factor     * median)
+
+- The **upper clamp** keeps adaptive mode at least as sensitive as the fixed
+  factor (everything the fixed policy would trip, adaptive trips too).
+- The **lower clamp** keeps a freakishly-quiet healthy phase (tiny variance)
+  from turning ordinary noise into trips.
+- **Warmup gating**: until the EWMA has ``warmup`` observations and nonzero
+  variance the fixed bound is used verbatim — cold-start moments are noise.
+
+When an :class:`obs.anomaly.AnomalyDetector` is live, its ``loss``
+:class:`~obs.anomaly.Ewma` is shared (the detector updates it on the flight
+recorder's flush cadence; this class only *reads*). Without a detector the
+instance owns a private ``Ewma`` and folds in every loss the sentinel
+flushes. Either way all arithmetic runs host-side on values the sentinel's
+ONE batched ``device_get`` already produced — the hot path stays zero-sync
+(GL001-clean), and ``spike_mode="fixed"`` never constructs this class at
+all, so the default policy is bit-identical to before.
+
+Pure stdlib + :mod:`obs.anomaly` (itself stdlib): importable jax-free.
+"""
+
+from __future__ import annotations
+
+import math
+
+from cst_captioning_tpu.obs.anomaly import Ewma
+
+
+class AdaptiveThresholds:
+    """EWMA-moment spike bound for :class:`resilience.sentinel.DivergenceSentinel`.
+
+    Parameters
+    ----------
+    factor_max:
+        The config's ``spike_factor`` — ceiling clamp, so adaptive mode is
+        never *looser* than the fixed policy it replaces.
+    factor_min:
+        The config's ``spike_factor_min`` — floor clamp against noise trips
+        when the healthy variance is near zero.
+    z:
+        How many EW-standard-deviations above the EW-mean the bound sits.
+        The default (3.0) is deliberately tighter than the anomaly
+        detector's z_threshold (4.0): a ramp must trip at ONSET, before the
+        shared moments chase it — once the EWMA converges onto a ramp its
+        variance inflates with the tracking lag and ``mean + 4*std`` never
+        falls below the current loss again. The ``factor_min`` floor, not a
+        large z, is what keeps healthy noise from tripping.
+    ewma:
+        A live :class:`~obs.anomaly.Ewma` to share (the anomaly detector's
+        ``loss`` stream); when ``None`` a private one is created and fed by
+        :meth:`observe`.
+    """
+
+    def __init__(self, factor_max: float, factor_min: float = 1.5,
+                 z: float = 3.0, ewma: Ewma | None = None,
+                 alpha: float = 0.1, warmup: int = 8):
+        if factor_max <= 0.0:
+            raise ValueError(f"factor_max {factor_max} must be > 0")
+        if not 0.0 < factor_min <= factor_max:
+            raise ValueError(
+                f"factor_min {factor_min} must be in (0, factor_max="
+                f"{factor_max}]")
+        if z <= 0.0:
+            raise ValueError(f"z {z} must be > 0")
+        self.factor_max = factor_max
+        self.factor_min = factor_min
+        self.z = z
+        self._shared = ewma is not None
+        self.ewma = ewma if ewma is not None else Ewma(alpha=alpha,
+                                                       warmup=warmup)
+
+    @property
+    def warmed(self) -> bool:
+        """Moments trustworthy enough to override the fixed bound."""
+        ew = self.ewma
+        return ew.n >= max(ew.warmup, 2) and ew.var > 0.0
+
+    def observe(self, loss: float) -> None:
+        """Fold one flushed (host-side, finite) loss into the moments —
+        no-op in shared mode, where the anomaly detector owns the updates
+        and double-counting would halve the effective memory."""
+        if not self._shared and math.isfinite(loss):
+            self.ewma.update(loss)
+
+    def bound(self, median: float, fixed_bound: float) -> float:
+        """The spike bound to compare this flush's loss against.
+
+        ``median`` is the sentinel's recent-loss median, ``fixed_bound`` the
+        fixed-policy bound (``spike_factor * median``). Falls back to
+        ``fixed_bound`` until warmed; the clamps only apply while the median
+        is positive (an RL loss can legitimately go negative, where
+        factor-of-median semantics stop meaning anything — there the bound
+        is the raw EWMA one, still capped at ``fixed_bound``)."""
+        if not self.warmed:
+            return fixed_bound
+        b = self.ewma.mean + self.z * math.sqrt(self.ewma.var)
+        if median > 0.0:
+            b = max(b, self.factor_min * median)
+        return min(b, fixed_bound)

@@ -178,7 +178,15 @@ class FaultPlan:
             from cst_captioning_tpu.obs import recorder as obs_recorder
 
             for f in due:
-                obs_recorder.note_fault(point, f.kind, visit=idx)
+                # the victim host rides in the bundle meta so the fleet
+                # merge can attribute a partial preemption to a named host
+                # (victim_host, not host — meta's `host` is the identity of
+                # the RECORDING process, set by the recorder itself)
+                extra = (
+                    {"victim_host": f.host}
+                    if f.kind == "partial_preempt" else {}
+                )
+                obs_recorder.note_fault(point, f.kind, visit=idx, **extra)
         # fire outside the lock: handlers/sleeps must not serialize threads
         for f in due:
             if f.kind == "kill":

@@ -63,6 +63,7 @@ class DivergenceSentinel:
         window: int = 32,
         warmup: int = 8,
         check_every: int | None = None,
+        adaptive: Any = None,
     ):
         if policy not in POLICIES:
             raise ValueError(f"unknown divergence policy {policy!r}")
@@ -72,6 +73,10 @@ class DivergenceSentinel:
         self.spike_factor = spike_factor
         self.warmup = warmup
         self.check_every = check_every
+        # spike_mode="adaptive": an AdaptiveThresholds (resilience/adaptive.py)
+        # tightens the spike bound from the anomaly detector's EWMA moments.
+        # None (spike_mode="fixed") keeps the median-factor policy untouched.
+        self.adaptive = adaptive
         self._recent: deque[float] = deque(maxlen=window)
         self._buf: list[tuple[int, Any, Any]] = []
         self.skipped = 0
@@ -104,16 +109,20 @@ class DivergenceSentinel:
         if bad or not math.isfinite(loss):
             self._diverged(step, loss, "nonfinite")
             return
-        if (
-            self.spike_factor
-            and len(self._recent) >= self.warmup
-            and loss > self.spike_factor * statistics.median(self._recent)
-        ):
-            self._diverged(step, loss, "spike")
-            return
+        if self.spike_factor and len(self._recent) >= self.warmup:
+            med = statistics.median(self._recent)
+            bound = self.spike_factor * med
+            if self.adaptive is not None:
+                bound = self.adaptive.bound(med, bound)
+            if loss > bound:
+                self._diverged(step, loss, "spike", bound=bound)
+                return
         self._recent.append(loss)
+        if self.adaptive is not None:
+            self.adaptive.observe(loss)
 
-    def _diverged(self, step: int, loss: float, kind: str) -> None:
+    def _diverged(self, step: int, loss: float, kind: str,
+                  bound: float | None = None) -> None:
         # skip_batch cannot un-apply a finite-but-spiked update — log only
         action = self.policy
         if kind == "spike" and self.policy == "skip_batch":
@@ -126,18 +135,24 @@ class DivergenceSentinel:
         # share ONE spelling: the same obs.anomaly.<kind> counter + anomaly
         # event, whoever saw it first — dashboards and the postmortem
         # timeline never disagree on what a divergence is called
+        # a spike verdict carries the bound it crossed — under adaptive mode
+        # that is the evidence for *why* this loss tripped when factor-of-
+        # median would not have
+        detail = {} if bound is None else {"bound": bound}
         obs_anomaly.record_anomaly(
-            kind, phase=self.phase, step=step, value=loss, source="sentinel"
+            kind, phase=self.phase, step=step, value=loss, source="sentinel",
+            **detail,
         )
         # flight-recorder postmortem: capture the ring around the diverged
         # step before any policy action (rollback restore, abort unwind)
         obs_recorder.postmortem(
             f"divergence_{kind}", phase=self.phase, step=step, loss=loss,
-            action=action,
+            action=action, **detail,
         )
         self.log(
             "divergence",
             phase=self.phase, step=step, loss=loss, kind=kind, action=action,
+            **detail,
         )
         if self.policy == "skip_batch":
             if kind == "nonfinite":

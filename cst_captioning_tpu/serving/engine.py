@@ -68,6 +68,7 @@ from cst_captioning_tpu.decoding.common import (
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
 from cst_captioning_tpu import obs
 from cst_captioning_tpu.obs import anomaly as obs_anomaly
+from cst_captioning_tpu.obs import recorder as obs_recorder
 from cst_captioning_tpu.obs.flops import enc_and_per_tok_flops
 from cst_captioning_tpu.resilience import chaos
 from cst_captioning_tpu.resilience.preempt import PreemptionHandler
@@ -489,6 +490,9 @@ class CaptionService:
                     snapshot=report.snapshot_dir,
                 )
                 obs.counter("serving.drains").inc()
+                # postmortem bundle BEFORE the working set is released: the
+                # pending/inflight census below is still live evidence
+                self._drain_postmortem(report)
                 # release the drained working set AFTER the snapshot
                 # captured the page table (the object stays reusable)
                 for slot in sorted(self._inflight):
@@ -506,6 +510,52 @@ class CaptionService:
         report.wall_s = now()
         report.completed = len(report.results)
         return report
+
+    def _drain_postmortem(self, report: ServeReport) -> None:
+        """A drained service leaves the same forensic a dying trainer does:
+        a flight-recorder postmortem bundle whose registry carries the SLO
+        snapshot, so ``cli.obs_report --postmortem`` diagnoses a SIGTERM /
+        peer-loss / chaos drain with the training tooling. Dumps through the
+        process-global recorder when one is configured (serving inside a
+        training run); otherwise an ephemeral recorder dropping the bundle
+        next to the obs event stream, or into the drain snapshot as a last
+        resort. Best-effort — a failed dump must never break the drain."""
+        extra = {
+            "serving": {
+                "drain_reason": self._drain_reason,
+                "pending": len(self._queue),
+                "inflight": len(self._inflight),
+                "slo": self.slo_snapshot(),
+            }
+        }
+        fields = dict(
+            drain_reason=self._drain_reason,
+            pending=len(self._queue),
+            inflight=len(self._inflight),
+        )
+        reason = f"serving_drain_{self._drain_reason or 'request'}"
+        try:
+            fr = obs_recorder.active()
+            if fr is not None:
+                fr.postmortem(reason, registry_extra=extra, **fields)
+                return
+            span_rec = obs.active()
+            out_dir = (
+                span_rec.out_dir if span_rec is not None
+                else report.snapshot_dir
+            )
+            if not out_dir:
+                return  # no obs, no snapshot: nowhere durable to dump
+            fr = obs_recorder.FlightRecorder(
+                1, out_dir, run="serving", max_dumps=1
+            )
+            try:
+                fr.postmortem(reason, registry_extra=extra, **fields)
+            finally:
+                fr.close()
+        except Exception:
+            # counted, not raised: drains run on the unwind path
+            obs.counter("serving.drain_postmortem_error").inc()
 
     def stride_cost(self) -> dict | None:
         """XLA HLO cost analysis of ONE compiled stride program

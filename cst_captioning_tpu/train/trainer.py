@@ -60,6 +60,7 @@ from cst_captioning_tpu.parallel import (
 )
 from cst_captioning_tpu.resilience import chaos
 from cst_captioning_tpu.resilience import health as health_mod
+from cst_captioning_tpu.resilience.adaptive import AdaptiveThresholds
 from cst_captioning_tpu.resilience.health import PeerLost
 from cst_captioning_tpu.resilience.preempt import Preempted, PreemptionHandler
 from cst_captioning_tpu.resilience.sentinel import (
@@ -168,15 +169,23 @@ class Trainer:
         # bit-identical either way (train/steps._apply), and recorder_steps=0
         # (default) builds literally the pre-recorder programs
         self._stats = bool(cfg.train.obs and cfg.train.recorder_steps > 0)
+        # kept on self: spike_mode="adaptive" shares this detector's loss
+        # Ewma with the sentinels built in _make_sentinel
+        self._detector = (
+            _anomaly.AnomalyDetector()
+            if self._stats and cfg.train.anomaly else None
+        )
         if self._stats:
             flight.configure(
                 cfg.train.recorder_steps,
                 obs_dir,
                 run=cfg.name,
-                detector=(
-                    _anomaly.AnomalyDetector() if cfg.train.anomaly else None
-                ),
+                detector=self._detector,
                 config=cfg.to_dict(),
+                # host identity: the fleet merge (obs/fleet.py) uses these to
+                # name hosts and detect absent procs in a degraded merge
+                proc=jax.process_index(),
+                world=jax.process_count(),
             )
         # everything below (state init, resume restore, first collate) is
         # run setup: give it a span so the report's phase totals account for
@@ -584,12 +593,27 @@ class Trainer:
         ``rollback``/``abort`` buy mid-epoch detection for one amortized
         device_get per 32 steps."""
         cfg = self.cfg.train
+        adaptive = None
+        if cfg.spike_mode == "adaptive" and cfg.spike_factor:
+            # the feedback loop (resilience/adaptive.py): the anomaly
+            # detector's loss Ewma — updated on the recorder's flush cadence
+            # — sets the spike bound; without a detector the thresholds own
+            # a private Ewma fed from the sentinel's flushes
+            adaptive = AdaptiveThresholds(
+                factor_max=cfg.spike_factor,
+                factor_min=cfg.spike_factor_min,
+                ewma=(
+                    self._detector.ewma("loss")
+                    if self._detector is not None else None
+                ),
+            )
         return DivergenceSentinel(
             policy=cfg.on_divergence,
             phase=phase,
             log=self.log.log,
             spike_factor=cfg.spike_factor,
             check_every=32 if cfg.on_divergence in ("rollback", "abort") else None,
+            adaptive=adaptive,
         )
 
     def _ckpt_infos(self, phase: str = "", batch_index: int = 0,
@@ -660,9 +684,11 @@ class Trainer:
         drain-aware order, then :class:`PeerLost` so the caller picks
         degraded continuation or the strict full-restart fallback."""
         sentinel.flush()
-        flight.postmortem("peer_loss", phase=phase, step=step_no)
-        self._save_step_ckpt(phase, step_no, batch_index, seam=seam)
+        # lost hosts computed BEFORE the dump so the bundle meta names the
+        # victim(s) — the fleet merge reads `lost` for trip attribution
         lost = self.health.lost()
+        flight.postmortem("peer_loss", phase=phase, step=step_no, lost=lost)
+        self._save_step_ckpt(phase, step_no, batch_index, seam=seam)
         obs.counter("resilience.peer_loss_drain").inc()
         self.log.log(
             "peer_loss_drain", phase=phase, step=step_no,

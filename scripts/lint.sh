@@ -44,6 +44,14 @@ python -m cst_captioning_tpu.cli.obs_report tests/fixtures/obs_run > /dev/null
 python -m cst_captioning_tpu.cli.obs_report \
     --postmortem tests/fixtures/postmortem_bundle > /dev/null
 
+# fleet-postmortem smoke: merge the committed 2-proc fixture (manifest
+# verify on every bundle, skew correction, trip attribution) and enumerate
+# its bundles — obs/fleet.py shares the no-jax contract, pinned here
+python -m cst_captioning_tpu.cli.obs_report \
+    --postmortem tests/fixtures/postmortem_fleet > /dev/null
+python -m cst_captioning_tpu.cli.obs_report \
+    --postmortem tests/fixtures/postmortem_fleet --list > /dev/null
+
 # decode fast-path smoke: tiny-dims CPU run of all three decode impls
 # (two-loop / fused one-loop / Pallas kernel) with the fused-vs-two-loop
 # bit-exactness gate inside — keeps bench_decode.py and the kernel from

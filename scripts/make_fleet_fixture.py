@@ -1,0 +1,87 @@
+"""Regenerate the committed 2-proc fleet-postmortem fixture.
+
+    python scripts/make_fleet_fixture.py [out_dir]
+
+Builds ``tests/fixtures/postmortem_fleet/`` with the layout a real
+2-process run leaves behind (obs/recorder.py): process 0's bundle in the
+run dir itself, process 1's under ``proc1/``. Process 0 is the *survivor*
+(peer-loss drain, ``lost=[1]`` in meta, a ``dcn_stall`` in its events
+tail); process 1 is the *victim* (nonfinite loss at step 7, its wall clock
+skewed +5 s so the fleet merge has real skew to correct). scripts/lint.sh
+smokes ``cli.obs_report --postmortem`` (fleet merge + --list) against the
+committed output; rerun this script only when the bundle schema changes.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import shutil
+import sys
+
+from cst_captioning_tpu import obs
+from cst_captioning_tpu.obs import anomaly as obs_anomaly
+from cst_captioning_tpu.obs import recorder as flight
+from cst_captioning_tpu.obs.span import wall_time as real_wall
+
+
+def main() -> int:
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "fixtures", "postmortem_fleet",
+    )
+    if os.path.isdir(out):
+        shutil.rmtree(out)
+    os.makedirs(out)
+
+    # ---- proc 0: the survivor -------------------------------------------
+    obs.configure(out, run="fleetfix")
+    fr0 = flight.FlightRecorder(
+        16, out, run="fleetfix", detector=obs_anomaly.AnomalyDetector(),
+        config={"name": "fleetfix"}, proc=0, world=2, host="host0",
+    )
+    for step in range(1, 9):
+        fr0.record(step, "rl", {"loss": 2.0 + 0.01 * step,
+                                "grad_norm": 1.0, "reward_mean": 0.5})
+        if step % 3 == 0:
+            fr0.flush()  # interior flushes -> extra anchor pairs
+    obs.event("dcn_stall", op="allreduce", dur_s=3.2)
+    fr0.postmortem("peer_loss", phase="rl", step=8, lost=[1])
+    fr0.close()
+    obs.shutdown()
+
+    # ---- proc 1: the victim, clock skewed +5 s --------------------------
+    saved = flight._wall_time
+    flight._wall_time = lambda: real_wall() + 5.0
+    try:
+        fr1 = flight.FlightRecorder(
+            16, os.path.join(out, "proc1"), run="fleetfix",
+            detector=obs_anomaly.AnomalyDetector(),
+            config={"name": "fleetfix"}, proc=1, world=2, host="host1",
+        )
+        for step in range(1, 8):
+            loss = math.nan if step == 7 else 2.0 + 0.011 * step
+            fr1.record(step, "rl", {"loss": loss, "grad_norm": 1.0,
+                                    "reward_mean": 0.5})
+            if step % 3 == 0:
+                fr1.flush()
+        fr1.postmortem("divergence_nonfinite", phase="rl", step=7,
+                       action="skip_batch")
+        fr1.close()
+    finally:
+        flight._wall_time = saved
+
+    from cst_captioning_tpu.obs.fleet import merge_bundles, render_fleet
+
+    fleet = merge_bundles(out)
+    print(render_fleet(fleet))
+    assert fleet["trip"]["proc"] == 1 and fleet["trip"]["step"] == 7, fleet[
+        "trip"]
+    assert fleet["victim_hosts"] == [1], fleet["victim_hosts"]
+    assert not fleet["degraded"]
+    print(f"\nfixture written to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -206,6 +206,70 @@ def test_postmortem_dump_budget(tmp_path):
     assert len(dumps) == 2
 
 
+def test_meta_schema2_carries_identity_and_anchor_table(tmp_path):
+    """Fleet-merge inputs (obs/fleet.py): schema-2 meta stamps the host
+    identity and a monotonic-to-wall anchor pair at start + each flush."""
+    fr = recorder.configure(8, str(tmp_path), run="t", proc=3, world=5,
+                            host="h3")
+    _drive(fr, 3)
+    fr.flush()
+    _drive(fr, 3, start=4)
+    fr.flush()
+    pm = load_postmortem(fr.postmortem("unit"))
+    meta = pm["meta"]
+    assert meta["schema"] == 2
+    assert (meta["proc"], meta["world"], meta["host"]) == (3, 5, "h3")
+    # start anchor + one per flush (the dump's own flush re-stamps the last)
+    assert len(meta["anchors"]) >= 3
+    offs = [wall - pc for pc, wall in meta["anchors"]]
+    assert offs == sorted(offs) or max(offs) - min(offs) < 5.0
+    # the render names the process
+    assert "proc: 3/5 (h3)" in render_postmortem(pm)
+
+
+def test_dump_budget_gauge_tracks_remaining(tmp_path):
+    fr = recorder.configure(4, str(tmp_path), run="t", max_dumps=2)
+
+    def left():
+        return obs.REGISTRY.snapshot()["gauges"]["obs.recorder.dump_budget"]
+
+    assert left() == 2.0  # published at configure time
+    _drive(fr, 2)
+    assert fr.postmortem("one") is not None and left() == 1.0
+    assert fr.postmortem("two") is not None and left() == 0.0
+    assert fr.postmortem("three") is None and left() == 0.0
+
+
+def test_ephemeral_recorder_does_not_clobber_budget_gauge(tmp_path):
+    """serving/engine.py drains may dump through a throwaway recorder while
+    a global one is live — the gauge tracks the GLOBAL budget only."""
+    fr = recorder.configure(4, str(tmp_path), run="t", max_dumps=3)
+    _drive(fr, 1)
+    fr.postmortem("one")
+    eph = recorder.FlightRecorder(2, str(tmp_path / "eph"), run="e",
+                                  max_dumps=1)
+    eph.record(1, "xe", {"loss": 1.0})
+    assert eph.postmortem("drain") is not None
+    eph.close()
+    snap = obs.REGISTRY.snapshot()["gauges"]
+    assert snap["obs.recorder.dump_budget"] == 2.0
+
+
+def test_postmortem_registry_extra_and_flush_error_render(tmp_path):
+    fr = recorder.configure(4, str(tmp_path), run="t")
+    _drive(fr, 2)
+    bundle = fr.postmortem(
+        "serving_drain_test",
+        registry_extra={"serving": {"slo": {"target_s": 0.5}}},
+    )
+    pm = load_postmortem(bundle)
+    assert pm["registry"]["serving"]["slo"]["target_s"] == 0.5
+    # a flush that died at dump time is called out ahead of the stale ring
+    pm["meta"]["flush_error"] = "RuntimeError: boom"
+    text = render_postmortem(pm)
+    assert "FLUSH FAILED" in text and "boom" in text
+
+
 def test_module_level_api_is_noop_when_unconfigured():
     recorder.shutdown()
     assert recorder.active() is None

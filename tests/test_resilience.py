@@ -15,6 +15,7 @@ from cst_captioning_tpu.ckpt import CheckpointManager, load_state, save_state
 from cst_captioning_tpu.config.config import ModelConfig, TrainConfig
 from cst_captioning_tpu.models import CaptionModel
 from cst_captioning_tpu.resilience import chaos
+from cst_captioning_tpu.resilience.adaptive import AdaptiveThresholds
 from cst_captioning_tpu.resilience.chaos import Fault, FaultPlan, SimulatedKill
 from cst_captioning_tpu.resilience.durable import (
     CorruptCheckpointError,
@@ -314,6 +315,121 @@ def test_sentinel_off_is_free():
     s.push(1, jnp.float32(float("nan")), jnp.float32(1.0))
     s.flush()  # no readback, no raise
     assert s._buf == []
+
+
+# ---- adaptive.py: anomaly-adaptive spike thresholds -------------------------
+
+
+def _run_sentinel(losses, adaptive=None, spike_factor=10.0):
+    """Push a loss stream through a skip_batch sentinel (spikes are logged,
+    the stream continues) and return the spike events."""
+    log = LogSink()
+    s = DivergenceSentinel(policy="skip_batch", log=log,
+                           spike_factor=spike_factor, warmup=4,
+                           adaptive=adaptive)
+    for i, v in enumerate(losses):
+        s.push(i, jnp.float32(v), None)
+        if i % 8 == 7:
+            s.flush()
+    s.flush()
+    return [e for e in log.of("divergence") if e["kind"] == "spike"]
+
+
+def test_adaptive_trips_on_slow_ramp_fixed_misses():
+    """ISSUE acceptance: a seeded healthy phase followed by a 10%/step loss
+    ramp trips spike_mode='adaptive' at ramp ONSET while the fixed factor
+    (which the ramp's drifting median never reaches) stays blind."""
+    rng = np.random.default_rng(0)
+    healthy = list(2.0 + rng.normal(0.0, 0.02, size=40))
+    ramp = [2.0 * 1.10 ** k for k in range(1, 25)]
+    losses = healthy + ramp
+
+    assert _run_sentinel(losses) == []  # fixed factor 10: never trips
+
+    spikes = _run_sentinel(
+        losses,
+        adaptive=AdaptiveThresholds(factor_max=10.0, factor_min=1.5),
+    )
+    assert spikes, "adaptive mode missed the ramp entirely"
+    first = spikes[0]
+    # tripped within the first handful of ramp steps, bound detail carried
+    assert 40 <= first["step"] <= 48
+    assert 0.0 < first["bound"] < first["loss"]
+
+
+def test_adaptive_never_trips_on_seeded_healthy_runs():
+    for seed in range(5):
+        rng = np.random.default_rng(seed)
+        losses = list(2.0 + rng.normal(0.0, 0.05, size=400))
+        spikes = _run_sentinel(
+            losses,
+            adaptive=AdaptiveThresholds(factor_max=10.0, factor_min=1.5),
+        )
+        assert spikes == [], f"false trip with seed {seed}: {spikes}"
+
+
+def test_adaptive_unwarmed_uses_fixed_bound_verbatim():
+    at = AdaptiveThresholds(factor_max=10.0, factor_min=1.5)
+    assert not at.warmed
+    assert at.bound(2.0, 20.0) == 20.0
+    for _ in range(16):
+        at.observe(2.0)  # zero variance: still not trustworthy
+    assert not at.warmed and at.bound(2.0, 20.0) == 20.0
+
+
+def test_adaptive_bound_clamps_to_factor_window():
+    at = AdaptiveThresholds(factor_max=10.0, factor_min=1.5,
+                            alpha=0.2, warmup=4)
+    rng = np.random.default_rng(1)
+    for v in 2.0 + rng.normal(0.0, 0.01, size=32):
+        at.observe(float(v))
+    assert at.warmed
+    # tiny variance: mean + 3 std ~ 2.05 -> the floor clamp lifts it
+    assert at.bound(2.0, 20.0) == pytest.approx(1.5 * 2.0, rel=1e-6)
+    # the ceiling clamp keeps adaptive never looser than fixed
+    assert at.bound(2.0, 2.5) == 2.5
+    # negative median (legit RL loss): raw EWMA bound, fixed cap only
+    b = at.bound(-0.5, 20.0)
+    assert 2.0 < b < 2.2
+
+
+def test_adaptive_shared_ewma_reads_detector_moments():
+    from cst_captioning_tpu.obs.anomaly import AnomalyDetector
+
+    det = AnomalyDetector(warmup=4)
+    shared = det.ewma("loss")
+    at = AdaptiveThresholds(factor_max=10.0, ewma=shared)
+    at.observe(5.0)  # shared mode: the detector owns updates; a no-op
+    assert shared.n == 0
+    for i in range(12):
+        det.observe("loss", 2.0 + 0.01 * i)
+    assert at.warmed  # detector updates flow straight through
+    assert at.bound(2.0, 20.0) < 20.0
+
+
+def test_adaptive_validation():
+    with pytest.raises(ValueError):
+        AdaptiveThresholds(factor_max=0.0)
+    with pytest.raises(ValueError):
+        AdaptiveThresholds(factor_max=10.0, factor_min=0.0)
+    with pytest.raises(ValueError):
+        AdaptiveThresholds(factor_max=10.0, factor_min=12.0)
+    with pytest.raises(ValueError):
+        AdaptiveThresholds(factor_max=10.0, z=0.0)
+
+
+def test_train_config_validates_spike_mode():
+    with pytest.raises(ValueError):
+        TrainConfig(spike_mode="bogus")
+    with pytest.raises(ValueError):
+        TrainConfig(spike_mode="adaptive")  # needs spike_factor > 0
+    with pytest.raises(ValueError):
+        TrainConfig(spike_mode="adaptive", spike_factor=10.0,
+                    spike_factor_min=0.0)
+    with pytest.raises(ValueError):
+        TrainConfig(spike_mode="adaptive", spike_factor=10.0,
+                    spike_factor_min=20.0)
+    TrainConfig(spike_mode="adaptive", spike_factor=10.0)  # valid
 
 
 # ---- preempt.py -------------------------------------------------------------

@@ -425,6 +425,46 @@ def test_serving_obs_events_and_report(setup, tmp_path):
     assert {"serving.stride", "serving.encode"} <= names
 
 
+def test_serving_drain_dumps_postmortem_with_slo_snapshot(setup, tmp_path):
+    """PR 13 satellite: a drained service leaves a flight-recorder
+    postmortem bundle whose registry carries the SLO snapshot, next to the
+    obs event stream, renderable by cli.obs_report --postmortem."""
+    from cst_captioning_tpu import obs
+    from cst_captioning_tpu.obs import metrics as obs_metrics
+    from cst_captioning_tpu.obs.report import load_postmortem
+
+    model, params = setup
+    obs_metrics.REGISTRY.reset()
+    run_dir = str(tmp_path / "obsrun")
+    obs.configure(run_dir, run="serve-drain")
+    svc = CaptionService(model, params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    svc.set_slo(30.0)
+    plan = FaultPlan([Fault("serving.step", "serving_preempt", at=3)])
+    try:
+        with plan.activate():
+            report = svc.serve(_requests(),
+                               snapshot_dir=str(tmp_path / "drain"))
+    finally:
+        obs.shutdown()
+    assert report.drained
+
+    (bundle,) = [
+        n for n in os.listdir(run_dir) if n.startswith("postmortem_")
+    ]
+    assert bundle.endswith("serving_drain_chaos_serving_preempt")
+    pm = load_postmortem(os.path.join(run_dir, bundle))
+    assert pm["verified"], pm["problems"]
+    meta = pm["meta"]
+    assert meta["drain_reason"] == "chaos_serving_preempt"
+    assert meta["pending"] + meta["inflight"] > 0  # drained mid-flight
+    sv = pm["registry"]["serving"]
+    assert sv["drain_reason"] == "chaos_serving_preempt"
+    assert sv["slo"] is not None and sv["slo"]["target_s"] == 30.0
+    snap = obs_metrics.snapshot()
+    assert snap["counters"].get("serving.drain_postmortem_error") is None
+
+
 # ---- SLO burn-rate monitor (Obs v2) -----------------------------------------
 
 
