@@ -1,0 +1,396 @@
+"""Eval fast-path bench: serial reference-beam vs pipelined lane-beam vs NPAD.
+
+Round-5 put end-to-end eval at 475.28 clips/s/chip with the host scoring
+half at 71.5% of wall-clock while the device sat idle (BENCH_EVAL_E2E.json)
+— eval was a SUM of a device stage and a host stage that never overlapped.
+This bench measures the three-mode ladder the eval fast path introduces:
+
+- ``serial_reference_beam`` — the round-5 shape: sequential
+  ``beam_impl="reference"`` decode, then host readback + id->word + full
+  metric table, one batch strictly after the other;
+- ``pipelined_lanes``       — the production evaluator's two-stage pipeline
+  (eval/evaluator.py): lane-batched beam (``beam_impl="lanes"``) decodes
+  batch i+1 while a worker thread scores batch i — wall-clock approaches
+  max(decode, score) instead of their sum;
+- ``npad_pipelined``        — NPAD anytime decoding (arXiv 1605.03835,
+  ``npad_decode``: 1 greedy + M noisy lanes, best sum-logprob lane wins)
+  through the same pipeline — the cheap-decode operating point.
+
+The in-run parity block is the acceptance spine: the lane beam's tokens
+AND scores are bit-exact vs the sequential reference at beam=5 f32, the
+pipelined metric tables are bit-identical to the serial ones (json-compared
+per batch), and NPAD's answer is sum-logprob >= greedy on every row. The
+smoke run exits nonzero if any of it fails and writes nothing.
+
+Writes ``BENCH_EVAL_E2E.json``: pipelined clips/s/chip as the headline,
+per-mode wall-clocks, decode/score stage totals + shares, the overlap
+ledger (fraction of scoring hidden under decode), the parity block, and an
+``acceptance`` dict — ``vs_committed_475_28`` on a flagship TPU run, a
+machine-checkable skip reason elsewhere.
+
+Usage: python bench_eval.py [--smoke] [--batch N] [--steps N] [--json PATH]
+  --smoke   tiny dims, 2 batches, no JSON unless --json given — the CPU
+            functional gate scripts/lint.sh runs (JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from bench import _synthetic_pools
+
+# bench.py's flagship operating point (BASELINE config 5 eval)
+BATCH, MAX_LEN, VOCAB, FRAMES = 256, 30, 9000, 10
+BEAM = 5
+
+COMMITTED = {
+    "value": 475.28,
+    "measured": "2026-07-30 round 5, python bench.py --phase eval_e2e",
+    "device_kind": "TPU v5 lite",
+}
+
+
+def _pc() -> float:
+    return time.perf_counter()
+
+
+def _parity_block(jax, jnp, model, params, feats, masks, max_len):
+    """The bit-parity contract, measured in-run on the f32 model: lane beam
+    vs sequential reference (tokens and scores), NPAD vs greedy monotone."""
+    from cst_captioning_tpu.decoding import (
+        beam_search, greedy_decode, npad_decode,
+    )
+
+    ref_tok, ref_sc = beam_search(
+        model, params, feats, masks, beam_size=BEAM, max_len=max_len,
+        min_len=1, beam_impl="reference",
+    )
+    lane_tok, lane_sc = beam_search(
+        model, params, feats, masks, beam_size=BEAM, max_len=max_len,
+        min_len=1, beam_impl="lanes",
+    )
+    _, g_lp = greedy_decode(
+        model, params, feats, masks, max_len=max_len, min_len=1
+    )
+    _, npad_sc = npad_decode(
+        model, params, feats, masks, jax.random.key(11), num_lanes=4,
+        max_len=max_len, min_len=1,
+    )
+    g_sum = np.asarray(g_lp.sum(axis=-1))
+    return {
+        "beam_size": BEAM,
+        "lanes_vs_reference_token_exact": bool(
+            np.array_equal(np.asarray(lane_tok), np.asarray(ref_tok))
+        ),
+        "lanes_vs_reference_score_bit_exact": bool(
+            np.asarray(lane_sc).tobytes() == np.asarray(ref_sc).tobytes()
+        ),
+        "npad_best_monotone": bool(
+            np.all(np.asarray(npad_sc) >= g_sum - 1e-6)
+        ),
+    }
+
+
+def _run_serial(jax, decode, params, feats, masks, steps, score_batch):
+    """Round-5 shape: decode, read back, score — strictly sequential."""
+    dt_dec = dt_sc = 0.0
+    tables = []
+    t_wall = _pc()
+    for i in range(steps):
+        t0 = _pc()
+        tok = jax.device_get(decode(params, feats, masks, i + 1))
+        dt_dec += _pc() - t0
+        t0 = _pc()
+        tables.append(score_batch(tok))
+        dt_sc += _pc() - t0
+    return tables, dt_dec, dt_sc, _pc() - t_wall
+
+
+def _run_pipelined(jax, decode, params, feats, masks, steps, score_batch):
+    """The evaluator's two-stage pipeline: dispatch batch i+1, read back
+    batch i, hand its scoring to the worker thread. One worker keeps the
+    shard order deterministic and the scorer instance single-threaded; the
+    decode dispatch and device_get release the GIL, so the worker's pure-
+    Python scoring genuinely overlaps the device stage."""
+
+    def timed(tok):
+        t0 = _pc()
+        table = score_batch(tok)
+        return table, _pc() - t0
+
+    def dispatch(i):
+        tokens = decode(params, feats, masks, i)
+        tokens.copy_to_host_async()
+        return tokens
+
+    dt_dec = dt_sc = 0.0
+    futs = []
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        t_wall = _pc()
+        pending = dispatch(1)
+        for i in range(2, steps + 1):
+            nxt = dispatch(i)
+            t0 = _pc()
+            tok = jax.device_get(pending)
+            dt_dec += _pc() - t0
+            futs.append(pool.submit(timed, tok))
+            pending = nxt
+        t0 = _pc()
+        tok = jax.device_get(pending)
+        dt_dec += _pc() - t0
+        futs.append(pool.submit(timed, tok))
+        t0 = _pc()
+        done = [f.result() for f in futs]
+        gather_wait = _pc() - t0
+        wall = _pc() - t_wall
+    tables = [t for t, _ in done]
+    dt_sc = sum(dt for _, dt in done)
+    hidden = max(0.0, dt_sc - gather_wait)
+    return tables, dt_dec, dt_sc, wall, hidden
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny dims / 2 batches; the CPU functional gate")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--json", default="", metavar="PATH",
+                    help="output path (default BENCH_EVAL_E2E.json; smoke "
+                         "writes no file unless given)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from cst_captioning_tpu.config.config import ModelConfig
+    from cst_captioning_tpu.decoding import beam_search, npad_decode
+    from cst_captioning_tpu.metrics.scorer import CaptionScorer
+    from cst_captioning_tpu.models import CaptionModel
+
+    if args.smoke:
+        batch = args.batch or 8
+        steps = args.steps or 2
+        vocab_n, frames, max_len = 97, 6, 12
+        modal = (("resnet", 16),)
+        d_embed = d_hidden = 16
+        d_att = 8
+        dtype = "float32"
+    else:
+        batch = args.batch or BATCH
+        steps = args.steps or 6
+        vocab_n, frames, max_len = VOCAB, FRAMES, MAX_LEN
+        modal = (("resnet", 2048), ("c3d", 500))
+        d_embed = d_hidden = 512
+        d_att = 256
+        dtype = "bfloat16"
+
+    backend = jax.default_backend()
+    kind = jax.devices()[0].device_kind
+    n_chips = len(jax.devices())
+    print(f"bench_eval: backend={backend} chips={n_chips} batch={batch} "
+          f"steps={steps}", file=sys.stderr)
+
+    cfg = ModelConfig(
+        vocab_size=vocab_n, modalities=modal, d_embed=d_embed,
+        d_hidden=d_hidden, d_att=d_att, encoder="temporal_attention",
+        dropout=0.0, max_len=max_len, max_frames=frames, dtype=dtype,
+    )
+    model = CaptionModel(cfg)
+    rng = np.random.default_rng(0)
+    feats = {
+        name: jnp.asarray(rng.normal(size=(batch, frames, dim)), jnp.float32)
+        for name, dim in modal
+    }
+    masks = {k: jnp.ones((batch, frames), jnp.float32) for k in feats}
+    labels = jnp.asarray(
+        rng.integers(4, vocab_n, size=(batch, max_len)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), feats, masks, labels)
+
+    vocab, vids, gts = _synthetic_pools(vocab_n, batch, rng)
+
+    # the parity contract is dims-independent (pinned across dims in
+    # tests/); measure it in-run on a small f32 twin so the bf16 flagship
+    # run still carries the bit-exactness evidence without an f32 recompile
+    # at flagship dims
+    if dtype == "float32":
+        p_model, p_params, p_feats, p_masks, p_maxlen = (
+            model, params, feats, masks, max_len
+        )
+        parity_dims = f"run dims (B={batch}, V={vocab_n}, f32)"
+    else:
+        p_cfg = ModelConfig(
+            vocab_size=499, modalities=(("resnet", 16),), d_embed=24,
+            d_hidden=24, d_att=12, encoder="temporal_attention",
+            dropout=0.0, max_len=16, max_frames=6, dtype="float32",
+        )
+        p_model = CaptionModel(p_cfg)
+        p_rng = np.random.default_rng(5)
+        p_feats = {"resnet": jnp.asarray(
+            p_rng.normal(size=(16, 6, 16)), jnp.float32
+        )}
+        p_masks = {"resnet": jnp.ones((16, 6), jnp.float32)}
+        p_labels = jnp.asarray(
+            p_rng.integers(4, 499, size=(16, 16)), jnp.int32
+        )
+        p_params = p_model.init(jax.random.key(2), p_feats, p_masks, p_labels)
+        p_maxlen = 16
+        parity_dims = "f32 twin (B=16, V=499)"
+    parity = _parity_block(
+        jax, jnp, p_model, p_params, p_feats, p_masks, p_maxlen
+    )
+    parity["parity_dims"] = parity_dims
+
+    # min_len=1 for the same reason as bench.py's eval bench: random-init
+    # params can argmax EOS at t=0; a guaranteed non-empty caption keeps the
+    # host scoring stage representative instead of degenerate
+    @jax.jit
+    def decode_serial(p, f, m, i):
+        f = {k: v + (i * 1e-6).astype(v.dtype) for k, v in f.items()}
+        return beam_search(model, p, f, m, beam_size=BEAM, max_len=max_len,
+                           min_len=1, beam_impl="reference")[0]
+
+    @jax.jit
+    def decode_lanes(p, f, m, i):
+        f = {k: v + (i * 1e-6).astype(v.dtype) for k, v in f.items()}
+        return beam_search(model, p, f, m, beam_size=BEAM, max_len=max_len,
+                           min_len=1, beam_impl="lanes")[0]
+
+    @jax.jit
+    def decode_npad(p, f, m, i):
+        f = {k: v + (i * 1e-6).astype(v.dtype) for k, v in f.items()}
+        return npad_decode(
+            model, p, f, m, jax.random.key(3), num_lanes=BEAM - 1,
+            max_len=max_len, min_len=1,
+        )[0]
+
+    # perturbation index as a traced jnp scalar (the bench_decode hygiene
+    # note: identical dispatches can be memoized; every rep must be real)
+    def idx(i):
+        return jnp.float32(i)
+
+    def make_score(scorer):
+        def score_batch(tok):
+            res = {vids[b]: [vocab.decode(tok[b])] for b in range(batch)}
+            return scorer.score(gts, res)
+        return score_batch
+
+    t0 = _pc()
+    for d in (decode_serial, decode_lanes, decode_npad):
+        jax.block_until_ready(d(params, feats, masks, idx(0)))
+    print(f"bench_eval: compile+warmup {(_pc() - t0):.1f}s", file=sys.stderr)
+
+    ser_tables, ser_dec, ser_sc, ser_wall = _run_serial(
+        jax, lambda p, f, m, i: decode_serial(p, f, m, idx(i)),
+        params, feats, masks, steps, make_score(CaptionScorer()),
+    )
+    pip_tables, pip_dec, pip_sc, pip_wall, hidden = _run_pipelined(
+        jax, lambda p, f, m, i: decode_lanes(p, f, m, idx(i)),
+        params, feats, masks, steps, make_score(CaptionScorer()),
+    )
+    _, npad_dec, npad_sc_t, npad_wall, _ = _run_pipelined(
+        jax, lambda p, f, m, i: decode_npad(p, f, m, idx(i)),
+        params, feats, masks, steps, make_score(CaptionScorer()),
+    )
+
+    parity["pipelined_vs_serial_metrics_bit_identical"] = bool(
+        json.dumps(ser_tables, sort_keys=True)
+        == json.dumps(pip_tables, sort_keys=True)
+    )
+
+    clips = batch * steps
+    per_chip = clips / pip_wall / max(n_chips, 1)
+    modes = {
+        "serial_reference_beam": round(clips / ser_wall / max(n_chips, 1), 2),
+        "pipelined_lanes": round(per_chip, 2),
+        "npad_pipelined": round(clips / npad_wall / max(n_chips, 1), 2),
+    }
+    overlap_fraction = hidden / pip_sc if pip_sc > 0 else 0.0
+    hideable = min(pip_dec, pip_sc)
+    print(
+        f"bench_eval: serial {ser_wall:.2f}s (decode {ser_dec:.2f}s + score "
+        f"{ser_sc:.2f}s) | pipelined {pip_wall:.2f}s "
+        f"({100 * overlap_fraction:.0f}% of scoring hidden) | npad "
+        f"{npad_wall:.2f}s -> {modes}", file=sys.stderr,
+    )
+
+    parity_ok = all(v for v in parity.values() if isinstance(v, bool))
+    if args.smoke and not parity_ok:
+        sys.exit(f"bench_eval: SMOKE FAILURE — eval parity gate failed: "
+                 f"{parity}")
+
+    flagship = (not args.smoke and batch == BATCH and max_len == MAX_LEN
+                and vocab_n == VOCAB)
+    out = {
+        "metric": "eval_e2e_clips_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "clips/s/chip",
+        "batch": batch,
+        "beam_size": BEAM,
+        "max_len": max_len,
+        "steps": steps,
+        "dtype": dtype,
+        "seconds": {"decode": round(pip_dec, 3), "score": round(pip_sc, 3)},
+        "shares": {
+            "decode": round(pip_dec / (pip_dec + pip_sc), 3),
+            "score": round(pip_sc / (pip_dec + pip_sc), 3),
+        },
+        "wall_s": {
+            "serial": round(ser_wall, 3),
+            "pipelined": round(pip_wall, 3),
+            "npad": round(npad_wall, 3),
+        },
+        "modes": modes,
+        "overlap": {
+            "fraction_of_scoring_hidden": round(overlap_fraction, 3),
+            "efficiency": round(
+                min(1.0, hidden / hideable) if hideable > 0 else 0.0, 3
+            ),
+            "hidden_s": round(hidden, 3),
+        },
+        "parity": parity,
+        "parity_ok": parity_ok,
+        "metrics_scored": list(CaptionScorer.KNOWN),
+        "device_kind": kind,
+        "backend": backend,
+        "smoke": bool(args.smoke),
+        "committed_reference": COMMITTED,
+        "acceptance": {
+            "vs_committed_475_28": (
+                round(per_chip / COMMITTED["value"], 3)
+                if flagship and backend == "tpu"
+                else "skipped_non_tpu" if backend != "tpu"
+                else "skipped_non_flagship_dims"
+            ),
+            "in_run_speedup_pipelined_vs_serial": round(
+                ser_wall / pip_wall, 3
+            ),
+        },
+        "measured": time.strftime("%Y-%m-%d") + ", python bench_eval.py"
+        + (" --smoke" if args.smoke else ""),
+        "note": (
+            None if backend == "tpu" else
+            "CPU run — wall-clocks measure raw host compute, not the TPU "
+            "operating point the committed 475.28 was recorded at; the "
+            "parity block, stage shares, and the in-run pipelined-vs-serial "
+            "speedup are structural and carry over. TPU rerun pending for "
+            "the vs_committed_475_28 acceptance comparison."
+        ),
+    }
+    print(json.dumps(out))
+    path = args.json or ("" if args.smoke else "BENCH_EVAL_E2E.json")
+    if path:
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"bench_eval: wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -372,6 +372,39 @@ class EvalConfig:
     # selector names understood by metrics.scorer.CaptionScorer
     metrics: tuple[str, ...] = ("Bleu", "ROUGE_L", "METEOR_approx", "CIDEr", "CIDEr-D")
     results_json: str = ""
+    # "lanes" = beam-on-decode-lanes fast path, "reference" = the sequential
+    # bit-parity oracle (decoding/beam.py; token- and score-bit-exact pair)
+    beam_impl: str = "lanes"
+    # NPAD anytime mode (arXiv 1605.03835): >0 decodes greedy + this many
+    # noise-perturbed lanes and answers with the best-sum-logprob lane
+    # INSTEAD of beam search — the latency-budget eval answer (0 = off)
+    npad_lanes: int = 0
+    npad_temperature: float = 1.0
+    npad_seed: int = 0
+    # two-stage eval pipeline: device decodes batch i+1 while a worker pool
+    # tokenizes batch i's captions on the host; metric tables stay
+    # bit-identical to the serial path (eval/evaluator.py)
+    pipelined: bool = True
+    score_workers: int = 4        # tokenizer threads feeding the drain
+
+    def __post_init__(self):
+        if self.beam_impl not in ("lanes", "reference"):
+            raise ValueError(
+                f"eval.beam_impl must be 'lanes' or 'reference', got "
+                f"{self.beam_impl!r}"
+            )
+        if self.npad_lanes < 0:
+            raise ValueError(
+                f"eval.npad_lanes {self.npad_lanes} must be >= 0 (0 = off)"
+            )
+        if self.npad_lanes and self.npad_temperature <= 0:
+            raise ValueError(
+                f"eval.npad_temperature {self.npad_temperature} must be > 0"
+            )
+        if self.score_workers < 1:
+            raise ValueError(
+                f"eval.score_workers {self.score_workers} must be >= 1"
+            )
 
 
 @dataclass(frozen=True)

@@ -8,7 +8,10 @@ per-step dispatch, so a whole decode is one XLA program.
 
 from cst_captioning_tpu.decoding.greedy import greedy_decode
 from cst_captioning_tpu.decoding.sample import sample_decode
-from cst_captioning_tpu.decoding.fused import fused_decode
+from cst_captioning_tpu.decoding.fused import fused_decode, npad_decode
 from cst_captioning_tpu.decoding.beam import beam_search
 
-__all__ = ["greedy_decode", "sample_decode", "fused_decode", "beam_search"]
+__all__ = [
+    "greedy_decode", "sample_decode", "fused_decode", "npad_decode",
+    "beam_search",
+]

@@ -23,6 +23,52 @@ def selected_logprob(logits: jnp.ndarray, token: jnp.ndarray) -> jnp.ndarray:
     return sel - lse
 
 
+def row_logprobs(logits: jnp.ndarray) -> jnp.ndarray:
+    """Full log-softmax row in the :func:`selected_logprob` association.
+
+    ``logits - logsumexp(logits, keepdims=True)`` — gathering a column of
+    this row is BITWISE equal to ``selected_logprob(logits, token)`` (same
+    subtraction, same operand order), which is what lets the beam loops
+    score whole rows while the greedy/sampling loops score one token, with
+    one shared primitive. Note this differs from ``jax.nn.log_softmax`` by
+    float association (``x - (max + log s)`` vs ``(x - max) - log s``), so
+    every decoder that wants cross-impl bit-parity must route through here.
+    """
+    return logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+
+
+def npad_best_lane_index(logprobs) -> jnp.ndarray:
+    """[G, .., T] per-token logprobs -> [..] best lane per row (NPAD pick).
+
+    Noisy Parallel Approximate Decoding (arXiv 1605.03835): run the greedy
+    lane plus M noise-perturbed lanes, answer with the highest-sum-logprob
+    lane. Post-EOS emissions carry logprob 0.0 (``step_outputs``), so the
+    sum is exactly the sequence logprob. argmax ties break toward the
+    LOWEST lane — lane 0 is the unperturbed greedy lane, so the anytime
+    answer degrades to greedy, never below it. Backend-agnostic on purpose
+    (pure array methods): the serving engine calls it on host numpy
+    tickets ([G, T] -> scalar), the evaluator on device arrays
+    ([G, B, T] -> [B]).
+    """
+    return logprobs.sum(axis=-1).argmax(axis=0)
+
+
+def npad_best_lane(tokens: jnp.ndarray, logprobs: jnp.ndarray):
+    """Select the NPAD answer: ([G, B, T], [G, B, T]) -> ([B, T], [B]).
+
+    Returns the best lane's token rows and their sum-logprob scores,
+    gathered with ``take_along_axis`` so the whole selection stays on
+    device (one scalar readback for the caller, not G of them).
+    """
+    best = npad_best_lane_index(logprobs)                       # [B]
+    idx = best[None, :, None]                                   # [1, B, 1]
+    best_tokens = jnp.take_along_axis(tokens, idx, axis=0)[0]   # [B, T]
+    best_scores = jnp.take_along_axis(
+        logprobs.sum(axis=-1), best[None, :], axis=0
+    )[0]                                                        # [B]
+    return best_tokens, best_scores
+
+
 def rollout_step_keys(rng: jax.Array, num_rollouts: int, length: int) -> jax.Array:
     """[T, K] typed key array with ``keys[t, k] == fold_in(fold_in(rng, k), t)``.
 

@@ -5,6 +5,22 @@ ids->words -> PTB tokenize -> BLEU/METEOR/ROUGE-L/CIDEr -> results json. Here
 the decode is one jitted fixed-shape program per batch and the metrics are the
 pure-Python scorers; results keep a schema in the reference's spirit:
 ``{"captions": {vid: text}, "metrics": {...}}``.
+
+Eval fast path (README): round-5 profiling put host metric scoring at 71.5%
+of eval wall-clock with the device idle the whole time, so ``evaluate`` runs
+a TWO-STAGE pipeline by default (``EvalConfig.pipelined``): the device
+decodes batch i+1 while a worker pool PTB-tokenizes batch i's captions (the
+per-caption half of scoring — the corpus scorers need the full split and run
+at the drain). Per-batch tokenization is independent and the drain assembles
+the tokenized dicts in the serial path's exact key order, so the metric
+table is BIT-IDENTICAL to the serial evaluator (pinned in
+tests/test_eval_pipeline.py) — eval wall-clock approaches
+max(decode, tokenize) + corpus instead of their sum (the Podracer
+actor/learner decoupling, arXiv 2104.06272, in miniature). The overlap
+ledger (eval.decode_seconds / eval.score_seconds histograms,
+eval.overlap_* gauges, fill/drain spans) feeds cli.obs_report's eval
+section. Decoding itself picks beam-on-lanes (``EvalConfig.beam_impl``) or
+the NPAD anytime mode (``EvalConfig.npad_lanes``, arXiv 1605.03835).
 """
 
 from __future__ import annotations
@@ -12,6 +28,8 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 import jax
@@ -23,8 +41,9 @@ from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.config.config import EvalConfig
 from cst_captioning_tpu.data.batcher import Batcher
 from cst_captioning_tpu.data.dataset import CaptionDataset
-from cst_captioning_tpu.decoding import beam_search, greedy_decode
+from cst_captioning_tpu.decoding import beam_search, greedy_decode, npad_decode
 from cst_captioning_tpu.metrics.scorer import CaptionScorer
+from cst_captioning_tpu.metrics.tokenizer import ptb_tokenize
 from cst_captioning_tpu.parallel import sp_batch_specs, sp_model
 from cst_captioning_tpu.train import multihost
 from cst_captioning_tpu.train.mesh import batch_sharding
@@ -79,11 +98,17 @@ class Evaluator:
         # dist-comm row) — host h5/collate/score work divides by process
         # count instead of being replicated everywhere
         self.multiproc = mesh is not None and multihost.is_multiprocess()
-        # construct (and thereby validate) the scorer up front, on EVERY
+        # construct (and thereby validate) the scorers up front, on EVERY
         # process: a bad metric selector failing only on process 0 after the
         # full decode would leave the other processes hung in the metric
-        # broadcast collective
+        # broadcast collective. The pre-tokenized twin scores the pipelined
+        # drain (its inputs already went through ptb_tokenize in the worker
+        # pool); persistent so the native CIDEr-D reference pool caches
+        # across evaluate calls, like the serial scorer's.
         self._scorer = CaptionScorer(metrics=self.cfg.metrics)
+        self._scorer_pre = CaptionScorer(
+            metrics=self.cfg.metrics, pre_tokenized=True
+        )
         self.batcher = Batcher(
             dataset, batch_size=batch_size, max_len=self.cfg.max_len,
             mode="video",
@@ -99,26 +124,37 @@ class Evaluator:
         # pcast their invariant inits over it + psum their early-exit count,
         # keeping check_vma ON (VERDICT r4 weak #3 closed)
         bx = ("data",) if mesh is not None else ()
-        if W > 1:
-            decode = lambda p, f, m: beam_search(
+        # every decode takes (params, feats, masks, rng); only the NPAD mode
+        # consumes the key (per-batch fold_in of npad_seed) — one uniform
+        # signature keeps the shard_map specs and the dispatch loop mode-free
+        self._decode_key = jax.random.key(self.cfg.npad_seed)
+        if self.cfg.npad_lanes > 0:
+            M, tmp = self.cfg.npad_lanes, self.cfg.npad_temperature
+            decode = lambda p, f, m, r: npad_decode(
+                dec_model, p, f, m, r, num_lanes=M, temperature=tmp,
+                max_len=T, min_len=ml, batch_axes=bx,
+            )[0]
+        elif W > 1:
+            decode = lambda p, f, m, r: beam_search(
                 dec_model, p, f, m, beam_size=W, max_len=T, min_len=ml,
                 length_penalty=lp, batch_axes=bx,
+                beam_impl=self.cfg.beam_impl,
             )[0]
         else:
-            decode = lambda p, f, m: greedy_decode(
+            decode = lambda p, f, m, r: greedy_decode(
                 dec_model, p, f, m, max_len=T, min_len=ml, batch_axes=bx
             )[0]
         self._fm_shardings = None
         if mesh is not None:
             if self.sp:
                 f_spec, m_spec = sp_batch_specs(model.cfg, "data")
-                in_specs = (P(), f_spec, m_spec)
+                in_specs = (P(), f_spec, m_spec, P())
                 self._fm_shardings = (
                     {k: NamedSharding(mesh, s) for k, s in f_spec.items()},
                     {k: NamedSharding(mesh, s) for k, s in m_spec.items()},
                 )
             else:
-                in_specs = (P(), P("data"), P("data"))
+                in_specs = (P(), P("data"), P("data"), P())
                 s = batch_sharding(mesh)
                 self._fm_shardings = (s, s)
             decode = shard_map(
@@ -128,6 +164,28 @@ class Evaluator:
                 out_specs=P("data"),
             )
         self._decode = jax.jit(decode)
+
+    def _dispatch(self, params, batch, bi: int):
+        """Collate-upload batch ``bi`` and launch its decode (async)."""
+        if self._fm_shardings is not None:
+            # numpy straight into the target sharding (single transfer)
+            put = (
+                multihost.put_global if self.multiproc
+                else multihost.put_full_global
+            )
+            feats, masks = put(
+                self._fm_shardings, (batch.feats, batch.feat_masks)
+            )
+        else:
+            feats, masks, *_ = batch_arrays(batch)
+        tokens = self._decode(
+            params, feats, masks, jax.random.fold_in(self._decode_key, bi)
+        )
+        if tokens.is_fully_addressable:
+            # start the device->host transfer now so it overlaps the next
+            # decode; by readback time the tokens are already on host
+            tokens.copy_to_host_async()
+        return tokens
 
     def generate(self, params) -> dict[str, str]:
         """Decode every video of the split -> {video_id: caption string}.
@@ -153,29 +211,14 @@ class Evaluator:
                 # already the matching local slice
                 tok = multihost.to_host_local(tokens, self.mesh, P("data"))
             else:
-                tok = np.asarray(tokens)
+                tok = jax.device_get(tokens)
             for i, ok in enumerate(batch.valid):
                 if ok:
                     out[batch.video_ids[i]] = self.ds.vocab.decode(tok[i])
 
         pending = None  # (device tokens, source batch) awaiting readback
-        for batch in self.batcher.epoch(shuffle=False):
-            if self._fm_shardings is not None:
-                # numpy straight into the target sharding (single transfer)
-                put = (
-                    multihost.put_global if self.multiproc
-                    else multihost.put_full_global
-                )
-                feats, masks = put(
-                    self._fm_shardings, (batch.feats, batch.feat_masks)
-                )
-            else:
-                feats, masks, *_ = batch_arrays(batch)
-            tokens = self._decode(params, feats, masks)
-            if tokens.is_fully_addressable:
-                # start the device->host transfer now so it overlaps this
-                # decode; by collect() time the tokens are already on host
-                tokens.copy_to_host_async()
+        for bi, batch in enumerate(self.batcher.epoch(shuffle=False)):
+            tokens = self._dispatch(params, batch, bi)
             if pending is not None:
                 collect(*pending)
             pending = (tokens, batch)
@@ -188,24 +231,160 @@ class Evaluator:
             out = merged
         return out
 
+    def _tok_res_shard(self, items):
+        """[(vid, token row)] -> ([(vid, text, ptb tokens)], worker seconds).
+
+        The per-caption half of scoring — runs on the worker pool WHILE the
+        device decodes later batches. ``vocab.decode`` and ``ptb_tokenize``
+        are pure functions of their inputs, so sharding them changes nothing
+        but when they run.
+        """
+        t0 = time.perf_counter()
+        out = []
+        for vid, row in items:
+            text = self.ds.vocab.decode(row)
+            out.append((vid, text, ptb_tokenize(text)))
+        return out, time.perf_counter() - t0
+
+    def _tok_gts_shard(self, items):
+        """[(vid, [ref strings])] -> ([(vid, [ptb tokens])], worker seconds)."""
+        t0 = time.perf_counter()
+        out = [
+            (vid, [ptb_tokenize(c) for c in caps]) for vid, caps in items
+        ]
+        return out, time.perf_counter() - t0
+
+    def _evaluate_pipelined(self, params):
+        """Two-stage decode/score pipeline -> (captions, metrics).
+
+        Stage 1 (device): the one-deep decode pipeline of ``generate``.
+        Stage 2 (host pool): per-batch caption tokenization, plus the
+        reference-pool tokenization fanned out BEFORE the first decode (the
+        references don't depend on the model). The drain gathers the shards
+        in submission order — batch order for hypotheses, ``gts_pool``
+        order for references, the serial path's exact dict orders — and
+        runs the corpus scorers on the pre-tokenized tables, so the metric
+        table is bit-identical to the serial evaluator's.
+        """
+        wall0 = time.perf_counter()
+        decode_total = 0.0
+        score_total = 0.0
+        dec_hist = obs.histogram("eval.decode_seconds")
+        sc_hist = obs.histogram("eval.score_seconds")
+        res_futs: list = []
+        with ThreadPoolExecutor(max_workers=self.cfg.score_workers) as pool:
+            gts_items = [
+                (vid, list(caps)) for vid, caps in self.ds.gts_pool().items()
+            ]
+            shard = max(1, -(-len(gts_items) // self.cfg.score_workers))
+            gts_futs = [
+                pool.submit(self._tok_gts_shard, gts_items[i:i + shard])
+                for i in range(0, len(gts_items), shard)
+            ]
+
+            def collect(tokens, batch):
+                nonlocal decode_total
+                t0 = time.perf_counter()
+                tok = jax.device_get(tokens)
+                dt = time.perf_counter() - t0
+                decode_total += dt
+                dec_hist.observe(dt)
+                obs.counter("eval.batches").inc()
+                items = [
+                    (batch.video_ids[i], tok[i])
+                    for i, ok in enumerate(batch.valid) if ok
+                ]
+                obs.counter("eval.captions").inc(len(items))
+                res_futs.append(pool.submit(self._tok_res_shard, items))
+
+            # fill: batch 0's collate + upload + decode dispatch — the
+            # pipeline's lead-in, before any decode/score overlap can exist
+            batches = enumerate(self.batcher.epoch(shuffle=False))
+            with obs.span("eval.pipeline.fill"):
+                t_f0 = time.perf_counter()
+                bi, batch = next(batches, (None, None))
+                pending = (
+                    (self._dispatch(params, batch, bi), batch)
+                    if batch is not None else None
+                )
+                fill_s = time.perf_counter() - t_f0
+            for bi, batch in batches:
+                tokens = self._dispatch(params, batch, bi)
+                collect(*pending)
+                pending = (tokens, batch)
+            if pending is not None:
+                collect(*pending)
+
+            # drain: decode is done — gather the tokenizer shards (mostly
+            # already resolved if the overlap worked) and run the corpus
+            # scorers, which need the full split
+            with obs.span("eval.pipeline.drain"):
+                t_d0 = time.perf_counter()
+                res_items: list = []
+                for fut in res_futs:
+                    out, dt = fut.result()
+                    score_total += dt
+                    sc_hist.observe(dt)
+                    res_items.extend(out)
+                gts_t: dict[str, list] = {}
+                for fut in gts_futs:
+                    out, dt = fut.result()
+                    score_total += dt
+                    sc_hist.observe(dt)
+                    for vid, toks in out:
+                        gts_t[vid] = toks
+                gather_wait = time.perf_counter() - t_d0
+                captions = {vid: text for vid, text, _ in res_items}
+                res_t = {vid: [toks] for vid, _, toks in res_items}
+                with obs.span("eval.score"):
+                    metrics = self._scorer_pre.score(gts_t, res_t)
+                drain_s = time.perf_counter() - t_d0
+
+        # the overlap ledger: scoring seconds that did NOT stall the drain
+        # were hidden under device decode. efficiency normalizes by the
+        # shorter stage — the most overlap the pipeline could possibly hide.
+        overlap_s = max(0.0, score_total - gather_wait)
+        hideable = min(decode_total, score_total)
+        obs.gauge("eval.overlap_fraction").set(
+            overlap_s / score_total if score_total > 0 else 0.0
+        )
+        obs.gauge("eval.overlap_efficiency").set(
+            min(1.0, overlap_s / hideable) if hideable > 0 else 0.0
+        )
+        obs.gauge("eval.pipeline.fill_s").set(fill_s)
+        obs.gauge("eval.pipeline.drain_s").set(drain_s)
+        obs.gauge("eval.decode_total_s").set(decode_total)
+        obs.gauge("eval.score_total_s").set(score_total)
+        obs.gauge("eval.wall_s").set(time.perf_counter() - wall0)
+        return captions, metrics
+
     def evaluate(self, params, results_json: str = "") -> dict[str, Any]:
         """generate + score; optionally write the results json.
 
-        Multi-host: only process 0 runs the metric scorers (pure host
-        compute on inputs every process already holds); the metrics dict is
+        Single-process with ``cfg.pipelined`` (default): the two-stage
+        decode/score pipeline (``_evaluate_pipelined`` — bit-identical
+        metric table, overlapped wall-clock). Multi-host keeps the serial
+        split: the tokenized shards live only on the process that decoded
+        them, and only process 0 runs the metric scorers (pure host compute
+        on inputs every process already holds); the metrics dict is
         broadcast so the return value is identical everywhere."""
         with obs.span("eval", split=self.ds.split):
-            captions = self.generate(params)
-            metrics = None
-            if not self.multiproc or jax.process_index() == 0:
-                gts = {
-                    vid: list(caps) for vid, caps in self.ds.gts_pool().items()
-                }
-                res = {vid: [captions[vid]] for vid in captions}
-                with obs.span("eval.score"):
-                    metrics = self._scorer.score(gts, res)
-            if self.multiproc:
-                metrics = multihost.broadcast_pyobj(metrics)
+            if self.cfg.pipelined and not self.multiproc:
+                captions, metrics = self._evaluate_pipelined(params)
+                obs.snapshot_metrics(split=self.ds.split)
+            else:
+                captions = self.generate(params)
+                metrics = None
+                if not self.multiproc or jax.process_index() == 0:
+                    gts = {
+                        vid: list(caps)
+                        for vid, caps in self.ds.gts_pool().items()
+                    }
+                    res = {vid: [captions[vid]] for vid in captions}
+                    with obs.span("eval.score"):
+                        metrics = self._scorer.score(gts, res)
+                if self.multiproc:
+                    metrics = multihost.broadcast_pyobj(metrics)
         result = {"split": self.ds.split, "metrics": metrics, "captions": captions}
         if results_json and self.multiproc and jax.process_index() != 0:
             # shared-filesystem contract (same as checkpointing): N identical

@@ -54,7 +54,8 @@ _PROC_DIR_RE = re.compile(r"^proc(\d+)$")
 # canonical phase order for the table; unknown names sort after, by total
 _PHASE_ORDER = (
     "setup", "xe.epoch", "xe.step", "rl.epoch", "rl.decode", "rl.reward",
-    "rl.update", "eval", "eval.score", "serving.admit", "serving.encode",
+    "rl.update", "eval", "eval.pipeline.fill", "eval.pipeline.drain",
+    "eval.score", "serving.admit", "serving.encode",
     "serving.stride", "serving.detok", "ckpt", "ckpt.save", "ckpt.restore",
     "dcn.collective", "degraded_rendezvous", "prefetch.stage",
     "profile.window",
@@ -305,6 +306,30 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
                 "alerts": counters.get("serving.slo.alerts", 0),
             }
 
+    # eval overlap ledger (eval/evaluator.py _evaluate_pipelined): per-batch
+    # decode-stage and per-shard score-stage histograms plus the stage-total
+    # gauges from the two-stage decode/score pipeline. None when the run
+    # never ran a pipelined eval (serial evaluator, multi-host, or no eval).
+    eval_sec = None
+    edec = histograms.get("eval.decode_seconds")
+    esc = histograms.get("eval.score_seconds")
+    if (edec and edec.get("count")) or (esc and esc.get("count")):
+        eval_sec = {
+            "batches": counters.get("eval.batches", 0),
+            "captions": counters.get("eval.captions", 0),
+            "decode_total_s": gauges.get("eval.decode_total_s", 0.0),
+            "score_total_s": gauges.get("eval.score_total_s", 0.0),
+            "wall_s": gauges.get("eval.wall_s", 0.0),
+            "decode_p50_s": _hist_quantile(edec, 0.50) if edec else 0.0,
+            "decode_p95_s": _hist_quantile(edec, 0.95) if edec else 0.0,
+            "score_p50_s": _hist_quantile(esc, 0.50) if esc else 0.0,
+            "score_p95_s": _hist_quantile(esc, 0.95) if esc else 0.0,
+            "overlap_fraction": gauges.get("eval.overlap_fraction", 0.0),
+            "overlap_efficiency": gauges.get("eval.overlap_efficiency", 0.0),
+            "fill_s": gauges.get("eval.pipeline.fill_s", 0.0),
+            "drain_s": gauges.get("eval.pipeline.drain_s", 0.0),
+        }
+
     resilience = {
         "nan_skips": counters.get("resilience.nan_skip", 0),
         "divergences": sum(
@@ -362,6 +387,7 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
         "overlap": overlap_rows,
         "decode": decode,
         "serving": serving,
+        "eval": eval_sec,
         "resilience": resilience,
         "health": health,
         "compile": {
@@ -504,6 +530,26 @@ def render_report(report: dict[str, Any]) -> str:
             bits.append(f"pages in use: {int(sv['pages_in_use'])}")
         if bits:
             lines.append("  " + "   ".join(bits))
+    ev = report.get("eval")
+    if ev:
+        lines.append("")
+        lines.append(
+            f"eval pipeline: {int(ev['batches'])} batch(es), "
+            f"{int(ev['captions'])} caption(s); stage totals decode "
+            f"{ev['decode_total_s']:.3f}s / score {ev['score_total_s']:.3f}s "
+            f"over {ev['wall_s']:.3f}s wall"
+        )
+        lines.append(
+            f"  decode p50/p95 {ev['decode_p50_s']:.4f}/"
+            f"{ev['decode_p95_s']:.4f}s   score p50/p95 "
+            f"{ev['score_p50_s']:.4f}/{ev['score_p95_s']:.4f}s"
+        )
+        lines.append(
+            f"  overlap: {100.0 * ev['overlap_fraction']:.1f}% of scoring "
+            f"hidden under decode (efficiency "
+            f"{100.0 * ev['overlap_efficiency']:.1f}% of the hideable "
+            f"stage)   fill {ev['fill_s']:.3f}s   drain {ev['drain_s']:.3f}s"
+        )
     r = report["resilience"]
     lines.append("")
     lines.append("resilience:")

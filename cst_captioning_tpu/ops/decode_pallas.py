@@ -884,3 +884,404 @@ def fused_decode_stride(cell_params, carry, token, finished, memory,
         min_len=min_len, block_b=block_b, block_v=block_v,
         interpret=interpret,
     )
+
+
+# ---- beam step kernel: per-step top-k moves INSIDE ---------------------------
+#
+# The lane-batched beam search (decoding/beam.py, beam_impl="lanes") maps
+# beams onto decode lanes, so its step is the per-step kernel above plus ONE
+# extra reduction: the top-W candidate selection over (lane, vocab). The
+# stride kernel's grid walks ALL steps of a lane before the next lane, which
+# makes the beam's cross-lane hypothesis reorder impossible mid-stride —
+# beams therefore ride a SINGLE-step launch (the reorder is a cross-lane
+# gather the caller runs between launches, at the same seam where
+# decoding/fused.py compacts finished columns), but the candidate selection
+# itself moves in-kernel so the [G, B, V] logits never leave VMEM:
+#
+#   grid (batch-block i, lane g, vocab-block vb) — per vocab block the
+#   kernel keeps (a) the stride kernel's online (max, sumexp) logsumexp and
+#   (b) a running in-lane top-W over the raw masked logits, merged blockwise
+#   (W max+mask passes — W is tiny). Raw-logit order equals logprob order
+#   within a lane (the lse is one per-lane scalar subtracted uniformly), so
+#   at the last vocab block the lane's W survivors become candidate totals
+#   ``score + (logit - lse)`` — the exact `row_logprobs` association the XLA
+#   beam scores with. Finished lanes contribute the closed-form PAD
+#   continuation (score at PAD, score-1e9 at the next W-1 token ids), and a
+#   cross-lane merge accumulated over g emits the global (total, flat) top-W
+#   per row, ties broken toward the lower flat index like `lax.top_k`.
+#
+# Per-lane truncation to W is lossless: the global top-W takes at most W
+# candidates from one lane, and in-lane ties keep the lowest column ids —
+# the same order the flattened top_k would. (Known rounding edge: two
+# DISTINCT raw logits whose totals round to equality at the f32 boundary
+# candidate W could order differently than the reference's full sort; the
+# parity suite has never observed it.) Requires W <= V so every lane can
+# fill its candidate list.
+
+def _beam_kernel(*refs, num_layers: int, m_true: int, V: int, W: int,
+                 min_len: int, block_v: int):
+    L = num_layers
+    it = iter(refs)
+    t_ref = next(it)
+    emb_ref, fin_ref, sc_ref = next(it), next(it), next(it)
+    carry_refs = [(next(it), next(it)) for _ in range(L)]
+    mem_ref, proj_ref, mask_ref = next(it), next(it), next(it)
+    wq_ref, bq_ref, v_ref = next(it), next(it), next(it)
+    lstm_refs = [(next(it), next(it), next(it)) for _ in range(L)]
+    wo_ref, bo_ref = next(it), next(it)
+    tsc_ref, tfl_ref = next(it), next(it)
+    carry_out_refs = [(next(it), next(it)) for _ in range(L)]
+    x_scr, val_scr, idx_scr, lm_scr, ls_scr, cv_scr, cf_scr = (
+        next(it), next(it), next(it), next(it), next(it), next(it), next(it))
+
+    g, vb = pl.program_id(1), pl.program_id(2)
+    G = pl.num_programs(1)
+    last_vb = vb == pl.num_programs(2) - 1
+    bb = x_scr.shape[0]
+
+    @pl.when(vb == 0)
+    def _():
+        # lane g's attention + LSTM stack (the per-step kernel's math) and
+        # carry write-out; then reset the per-lane selection state
+        h_top = carry_refs[L - 1][1][0].astype(jnp.float32)
+        q = (
+            jnp.dot(h_top, wq_ref[:].astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+            + bq_ref[:].astype(jnp.float32)
+        )
+        t = jnp.tanh(proj_ref[:].astype(jnp.float32) + q[:, None, :])
+        s = jnp.sum(t * v_ref[0].astype(jnp.float32)[None, None, :], axis=-1)
+        s = jnp.where(mask_ref[:] > 0, s, NEG)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col < m_true, s, -jnp.inf)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        w = p / jnp.sum(p, axis=-1, keepdims=True)
+        ctx = jnp.sum(w[:, :, None] * mem_ref[:].astype(jnp.float32), axis=1)
+        x = jnp.concatenate([emb_ref[0].astype(jnp.float32), ctx], axis=-1)
+        for layer in range(L):
+            c_ref, h_ref = carry_refs[layer]
+            wi_ref, wh_ref, b_ref = lstm_refs[layer]
+            c_new, h_new = _lstm_math(
+                x, c_ref[0].astype(jnp.float32), h_ref[0].astype(jnp.float32),
+                wi_ref[:].astype(jnp.float32), wh_ref[:].astype(jnp.float32),
+                b_ref[:].astype(jnp.float32),
+            )
+            c_out, h_out = carry_out_refs[layer]
+            c_out[0] = c_new.astype(c_out.dtype)
+            h_out[0] = h_new.astype(h_out.dtype)
+            x = h_new
+        x_scr[:] = x
+        # in-lane running top-W: -inf values under ids past any real column
+        # (2**20 > any padded vocab id), so real candidates displace them
+        val_scr[:] = jnp.full_like(val_scr[:], -jnp.inf)
+        idx_scr[:] = 2**20 + jax.lax.broadcasted_iota(
+            jnp.int32, idx_scr.shape, 1
+        )
+        lm_scr[:] = jnp.full_like(lm_scr[:], -jnp.inf)
+        ls_scr[:] = jnp.zeros_like(ls_scr[:])
+
+    logits = (
+        jnp.dot(x_scr[:], wo_ref[:].astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+        + bo_ref[:].astype(jnp.float32)
+    )                                                   # [bb, block_v]
+    col = vb * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    # forbid_special + apply_min_len, in-kernel (t is an SMEM scalar)
+    logits = jnp.where((col == PAD_ID) | (col == BOS_ID), NEG, logits)
+    if min_len > 0:
+        logits = jnp.where(
+            (t_ref[0] < min_len) & (col == EOS_ID), NEG, logits
+        )
+    lm = jnp.where(col < V, logits, -jnp.inf)  # padding cols: excluded
+    # online logsumexp over the masked logits (the lane's row_logprobs lse)
+    bm = jnp.max(lm, axis=-1, keepdims=True)
+    m_new = jnp.maximum(lm_scr[:], bm)
+    ls_scr[:] = (
+        ls_scr[:] * jnp.exp(lm_scr[:] - m_new)
+        + jnp.sum(jnp.exp(lm - m_new), axis=-1, keepdims=True)
+    )
+    lm_scr[:] = m_new
+    # blocked in-lane top-W merge: union of this block's columns with the
+    # running list (ids are globally unique — blocks cover disjoint column
+    # ranges), W passes of (max, min-id-among-ties, mask-out) — `lax.top_k`
+    # order: value descending, ties toward the lower id
+    allv = jnp.concatenate([lm, val_scr[:]], axis=1)
+    alli = jnp.concatenate([col, idx_scr[:]], axis=1)
+    new_v, new_i = [], []
+    for _ in range(W):
+        mv = jnp.max(allv, axis=1, keepdims=True)
+        pick = jnp.min(
+            jnp.where(allv == mv, alli, 2**30), axis=1, keepdims=True
+        )
+        new_v.append(mv)
+        new_i.append(pick)
+        allv = jnp.where(alli == pick, -jnp.inf, allv)
+    val_scr[:] = jnp.concatenate(new_v, axis=1)
+    idx_scr[:] = jnp.concatenate(new_i, axis=1)
+
+    @pl.when(last_vb)
+    def _():
+        # finalize lane g: W candidate (total, flat) pairs — live lanes
+        # score their survivors in the row_logprobs association, finished
+        # lanes emit the closed-form PAD continuation row's top-W
+        lse = lm_scr[:] + jnp.log(ls_scr[:])            # [bb, 1]
+        fin = fin_ref[0][:, None] > 0                   # [bb, 1]
+        sc = sc_ref[0][:, None]                         # [bb, 1]
+        wio = jax.lax.broadcasted_iota(jnp.int32, (bb, W), 1)
+        live_tot = sc + (val_scr[:] - lse)
+        live_flat = g * V + idx_scr[:]
+        fin_tot = sc + jnp.where(wio == 0, 0.0, NEG)
+        fin_flat = g * V + wio                          # PAD, then ids 1..W-1
+        tot = jnp.where(fin, fin_tot, live_tot)
+        flat = jnp.where(fin, fin_flat, live_flat)
+
+        @pl.when(g == 0)
+        def _():
+            cv_scr[:] = tot
+            cf_scr[:] = flat
+
+        @pl.when(g > 0)
+        def _():
+            # cross-lane merge: top-W of the 2W union, ties toward the
+            # lower flat index (flats are unique across lanes)
+            av = jnp.concatenate([cv_scr[:], tot], axis=1)
+            ai = jnp.concatenate([cf_scr[:], flat], axis=1)
+            mv_l, mi_l = [], []
+            for _ in range(W):
+                mv = jnp.max(av, axis=1, keepdims=True)
+                pick = jnp.min(
+                    jnp.where(av == mv, ai, 2**30), axis=1, keepdims=True
+                )
+                mv_l.append(mv)
+                mi_l.append(pick)
+                av = jnp.where(ai == pick, -jnp.inf, av)
+            cv_scr[:] = jnp.concatenate(mv_l, axis=1)
+            cf_scr[:] = jnp.concatenate(mi_l, axis=1)
+
+        @pl.when(g == G - 1)
+        def _():
+            tsc_ref[:] = cv_scr[:]
+            tfl_ref[:] = cf_scr[:]
+
+
+def _reference_beam_topk(cell_params, carry, token, finished, scores,
+                         memory, memory_proj, memory_mask, *, t,
+                         min_len: int):
+    """The beam step + candidate selection as a plain-jnp composite: one
+    `_reference` step, `row_logprobs` scoring, PAD continuation for finished
+    lanes, one `top_k` over the flattened W*V candidates — the interpret-
+    mode shard_map fallback and the kernel's parity oracle."""
+    new_carry, logits = _reference(
+        cell_params, carry, token, memory, memory_proj, memory_mask
+    )
+    neg = jnp.full_like(logits[..., :1], NEG)
+    logits = (
+        logits.at[..., PAD_ID].set(neg[..., 0])
+        .at[..., BOS_ID].set(neg[..., 0])
+    )
+    if min_len > 0:
+        blocked = logits.at[..., EOS_ID].set(NEG)
+        logits = jnp.where(t < min_len, blocked, logits)
+    W, B = token.shape
+    V = logits.shape[-1]
+    logp = logits - jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    logp = logp.transpose(1, 0, 2)                      # [B, W, V]
+    pad_row = jnp.full((V,), NEG).at[PAD_ID].set(0.0)
+    cont = jnp.where(finished.T[:, :, None], pad_row[None, None, :], logp)
+    total = scores.T[:, :, None] + cont
+    top_scores, flat = jax.lax.top_k(total.reshape(B, W * V), W)
+    return new_carry, top_scores, flat.astype(jnp.int32)
+
+
+def _beam_call(cell_params, carry, emb, finished, scores, memory,
+               memory_proj, memory_mask, t, *, min_len: int, block_b: int,
+               block_v: int, interpret: bool):
+    L = _num_layers(cell_params)
+    G, B, E = emb.shape
+    M = memory.shape[1]
+    Em = memory.shape[2]
+    A = memory_proj.shape[2]
+    H = carry[0][0].shape[-1]
+    wo = cell_params["out_proj"]["kernel"]
+    bo = cell_params["out_proj"]["bias"][None, :]
+    V = wo.shape[-1]
+
+    block_b = min(block_b, B) if B else block_b
+    Bp = -(-B // block_b) * block_b
+    block_v = min(block_v, -(-V // 128) * 128 if V > 128 else V)
+    Vp = -(-V // block_v) * block_v
+    Mp = -(-M // 128) * 128 if not interpret else M
+
+    embp = _pad_to(emb, 1, block_b)
+    # padded rows are born finished with score 0 — their candidate rows are
+    # sliced off below, never merged into a real row's top-W (the merge is
+    # per batch row)
+    finp = _pad_to(finished.astype(jnp.int32), 1, block_b, value=1)
+    scp = _pad_to(scores.astype(jnp.float32), 1, block_b)
+    carryp = [
+        (_pad_to(c, 1, block_b), _pad_to(h, 1, block_b)) for c, h in carry
+    ]
+    memp = _pad_to(_pad_to(memory, 0, block_b), 1, Mp)
+    projp = _pad_to(_pad_to(memory_proj, 0, block_b), 1, Mp)
+    maskp = _pad_to(_pad_to(memory_mask, 0, block_b), 1, Mp)
+    wop = _pad_to(wo, 1, block_v)
+    bop = _pad_to(bo, 1, block_v)
+    Mp = maskp.shape[1]
+
+    att = cell_params["attention"]
+    wq = att["query_proj"]["kernel"]
+    bq = att["query_proj"]["bias"][None, :]
+    vs = att["score"]["kernel"][:, 0][None, :]
+
+    smem = pl.BlockSpec((1,), lambda i, g, vb: (0,), memory_space=pltpu.SMEM)
+    const = lambda i, g, vb: (0, 0)   # noqa: E731 — grid-invariant (resident)
+    in_specs = [smem]
+    args = [jnp.asarray(t, jnp.int32).reshape(1)]
+    in_specs += [
+        pl.BlockSpec((1, block_b, E), lambda i, g, vb: (g, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_b), lambda i, g, vb: (g, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_b), lambda i, g, vb: (g, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [embp, finp, scp]
+    for c, h in carryp:
+        for arr in (c, h):
+            in_specs.append(
+                pl.BlockSpec((1, block_b, H), lambda i, g, vb: (g, i, 0),
+                             memory_space=pltpu.VMEM)
+            )
+            args.append(arr)
+    in_specs += [
+        pl.BlockSpec((block_b, Mp, Em), lambda i, g, vb: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, Mp, A), lambda i, g, vb: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, Mp), lambda i, g, vb: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((H, A), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, A), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, A), const, memory_space=pltpu.VMEM),
+    ]
+    args += [memp, projp, maskp, wq, bq, vs]
+    for layer in range(L):
+        wi, wh, b = _gate_weights(cell_params[f"lstm{layer}"])
+        in_specs += [
+            pl.BlockSpec(wi.shape, const, memory_space=pltpu.VMEM),
+            pl.BlockSpec(wh.shape, const, memory_space=pltpu.VMEM),
+            pl.BlockSpec(b.shape, const, memory_space=pltpu.VMEM),
+        ]
+        args += [wi, wh, b]
+    in_specs += [
+        pl.BlockSpec((H, block_v), lambda i, g, vb: (0, vb),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_v), lambda i, g, vb: (0, vb),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [wop, bop]
+
+    vma = frozenset()
+    for x in (emb, memory, memory_proj, memory_mask, finished, scores,
+              *jax.tree.leaves(carry)):
+        vma = vma | vma_of(x)
+    sds = (
+        (lambda sh, d: jax.ShapeDtypeStruct(sh, d, vma=vma)) if vma
+        else jax.ShapeDtypeStruct
+    )
+    W = G
+    out_shape = [sds((Bp, W), jnp.float32), sds((Bp, W), jnp.int32)]
+    out_specs = [
+        pl.BlockSpec((block_b, W), lambda i, g, vb: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, W), lambda i, g, vb: (i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    for c, h in carry:
+        for arr in (c, h):
+            out_shape.append(sds((G, Bp, H), arr.dtype))
+            out_specs.append(
+                pl.BlockSpec((1, block_b, H), lambda i, g, vb: (g, i, 0),
+                             memory_space=pltpu.VMEM)
+            )
+
+    grid = (Bp // block_b, G, Vp // block_v)
+    outs = pl.pallas_call(
+        functools.partial(
+            _beam_kernel, num_layers=L, m_true=M, V=V, W=W,
+            min_len=min_len, block_v=block_v,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_b, H), jnp.float32),    # x_stash
+            pltpu.VMEM((block_b, W), jnp.float32),    # in-lane top-W values
+            pltpu.VMEM((block_b, W), jnp.int32),      # in-lane top-W col ids
+            pltpu.VMEM((block_b, 1), jnp.float32),    # online lse max
+            pltpu.VMEM((block_b, 1), jnp.float32),    # online lse sumexp
+            pltpu.VMEM((block_b, W), jnp.float32),    # cross-lane totals
+            pltpu.VMEM((block_b, W), jnp.int32),      # cross-lane flat ids
+        ],
+        interpret=interpret,
+    )(*args)
+    top_scores = outs[0][:B]
+    top_flat = outs[1][:B]
+    flat = outs[2:]
+    new_carry = tuple(
+        (flat[2 * layer][:, :B], flat[2 * layer + 1][:, :B])
+        for layer in range(L)
+    )
+    return new_carry, top_scores, top_flat
+
+
+def fused_beam_step(cell_params, carry, token, finished, scores, memory,
+                    memory_proj, memory_mask, *, t, min_len: int = 0,
+                    num_layers: int | None = None, block_b: int = 32,
+                    block_v: int = 1024):
+    """Fused beam step: decode + in-kernel top-W candidate selection.
+
+    -> ``(new_carry, top_scores [B, W] f32, top_flat [B, W] int32)`` — the
+    per-row global top-W over all (lane, token) candidates, ``flat = lane *
+    V + token`` exactly like the XLA beam's flattened ``top_k``. The caller
+    (decoding/beam.py) derives parent/token from ``flat`` and performs the
+    hypothesis reorder between launches.
+
+    Args beyond :func:`fused_decode_step`'s: ``finished`` [W, B] bool lanes
+    already past EOS (they contribute the PAD continuation row),
+    ``scores`` [W, B] f32 running hypothesis scores, ``t`` the global step
+    index (traced; for ``min_len`` masking). Requires beam width <= vocab
+    (section comment). Inference-only, like the other decode kernels.
+    """
+    if num_layers is not None and num_layers != _num_layers(cell_params):
+        raise ValueError(
+            f"num_layers {num_layers} does not match the "
+            f"{_num_layers(cell_params)} lstm layers in cell_params"
+        )
+    W, B = token.shape
+    V = cell_params["out_proj"]["kernel"].shape[-1]
+    if W > V:
+        raise ValueError(
+            f"fused_beam_step needs beam width <= vocab to fill every "
+            f"lane's candidate list; got W={W} > V={V}"
+        )
+    interpret = jax.default_backend() != "tpu"
+    if interpret and any(
+        vma_of(x)
+        for x in (memory, memory_proj, memory_mask, finished, scores,
+                  *jax.tree.leaves(carry))
+    ):
+        # Pallas interpret mode can't run under a varying-axis-checked
+        # shard_map — the composite carries it (CPU tests only)
+        return _reference_beam_topk(
+            cell_params, carry, token, finished, scores, memory,
+            memory_proj, memory_mask, t=t, min_len=min_len,
+        )
+    emb = jnp.asarray(cell_params["word_embed"]["embedding"])[token]
+    return _beam_call(
+        cell_params, carry, emb, finished, scores, memory, memory_proj,
+        memory_mask, t, min_len=min_len, block_b=block_b, block_v=block_v,
+        interpret=interpret,
+    )

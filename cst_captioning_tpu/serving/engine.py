@@ -62,6 +62,7 @@ from cst_captioning_tpu.decoding.common import (
     forbid_special,
     gumbel_step_noise,
     lane_decode_step,
+    npad_best_lane_index,
     selected_logprob,
     step_outputs,
 )
@@ -893,8 +894,7 @@ class CaptionService:
     def _complete(self, ticket: _Ticket, report: ServeReport, now) -> None:
         with obs.span("serving.detok", req=ticket.req.req_id):
             t_det0 = time.perf_counter()
-            lane_scores = ticket.lp.sum(axis=1)
-            best = int(np.argmax(lane_scores))
+            best = int(npad_best_lane_index(ticket.lp))
             row = ticket.tok[best]
             ids: list[int] = []
             for tok in row:
@@ -1108,7 +1108,7 @@ def static_batch_serve(
         for i, req in enumerate(batch):
             tok = np.concatenate([g[i][None], s[:, i]], axis=0)
             lp = np.concatenate([gl[i][None], sl[:, i]], axis=0)
-            best = int(np.argmax(lp.sum(axis=1)))
+            best = int(npad_best_lane_index(lp))
             ids: list[int] = []
             for t in tok[best]:
                 t = int(t)

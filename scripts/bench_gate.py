@@ -1,0 +1,170 @@
+"""Gate every committed BENCH_*.json: parse, parity, acceptance, schema.
+
+The bench JSONs are the repo's performance evidence — ROADMAP rounds and
+the READMEs cite them — but nothing re-validated them after commit: a
+bench edited to emit a new schema, a parity bool that silently flipped
+false, or a truncated file from a killed run would all sit in the tree
+unnoticed. This gate (run by scripts/lint.sh) re-reads every one and
+enforces the invariants the benches themselves promise:
+
+- the file parses as JSON (no torn writes);
+- every ``parity`` block's booleans are ALL true, and every
+  ``*_token_match_frac`` in one is >= 0.9 (the bf16 near-tie argmax
+  allowance the decode benches document — anything lower is a real
+  selection bug, not tie noise);
+- ``parity_ok``, where present, is true;
+- ``acceptance`` blocks and ``vs_*`` comparison fields hold either real
+  measurements (numbers / dicts of true booleans) or a machine-checkable
+  skip reason (a string starting with ``"skipped"``) — never false, never
+  an unexplained null;
+- the round ledgers (``BENCH_r0*.json``) carry the driver schema
+  (n / cmd / rc / parsed) with rc == 0;
+- the flagship summaries carry a ``metric`` name, and any non-TPU rerun
+  carries the standard TPU-rerun ``note`` so a CPU number can never be
+  mistaken for the committed TPU operating point.
+
+Exit nonzero on the first file with violations, listing all of them.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import numbers
+import os
+import sys
+
+# round ledgers written by the growth driver: a fixed schema, rc must be 0
+ROUND_KEYS = {"n", "cmd", "rc", "parsed"}
+
+
+def _check_parity(path: str, key: str, block, errors: list[str]) -> None:
+    if not isinstance(block, dict):
+        errors.append(f"{path}: {key} is not a dict")
+        return
+    for k, v in block.items():
+        if isinstance(v, bool):
+            if not v:
+                errors.append(f"{path}: {key}.{k} is false")
+        elif k.endswith("_token_match_frac"):
+            if not (isinstance(v, numbers.Real) and v >= 0.9):
+                errors.append(
+                    f"{path}: {key}.{k} = {v!r} below the 0.9 tie-noise "
+                    "floor"
+                )
+
+
+def _check_acceptance(path: str, key: str, v, errors: list[str]) -> None:
+    """Acceptance values: number (a measured ratio), true bool, a dict of
+    acceptance values, or a ``skipped*`` reason string."""
+    if isinstance(v, bool):
+        if not v:
+            errors.append(f"{path}: {key} is false")
+    elif isinstance(v, numbers.Real):
+        pass
+    elif isinstance(v, str):
+        if not v.startswith("skipped"):
+            errors.append(
+                f"{path}: {key} = {v!r} is neither a measurement nor a "
+                "'skipped*' reason"
+            )
+    elif isinstance(v, dict):
+        for k2, v2 in v.items():
+            _check_acceptance(path, f"{key}.{k2}", v2, errors)
+    else:
+        errors.append(f"{path}: {key} = {v!r} (unexpected acceptance type)")
+
+
+def _walk(path: str, node, errors: list[str], key: str = "") -> None:
+    if isinstance(node, dict):
+        for k, v in node.items():
+            sub = f"{key}.{k}" if key else k
+            if k == "parity":
+                _check_parity(path, sub, v, errors)
+            elif k == "parity_ok":
+                if v is not True:
+                    errors.append(f"{path}: {sub} = {v!r} (must be true)")
+            elif k == "acceptance" or k.startswith("vs_"):
+                _check_acceptance(path, sub, v, errors)
+            else:
+                _walk(path, v, errors, sub)
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            _walk(path, v, errors, f"{key}[{i}]")
+
+
+def check_file(path: str) -> list[str]:
+    errors: list[str] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: does not parse as JSON ({e})"]
+
+    name = os.path.basename(path)
+    if name.startswith("BENCH_r"):
+        missing = ROUND_KEYS - set(data)
+        if missing:
+            errors.append(
+                f"{path}: round ledger missing {sorted(missing)}"
+            )
+        if data.get("rc") != 0:
+            errors.append(f"{path}: round ledger rc = {data.get('rc')!r}")
+        return errors
+
+    # flagship summaries: every bench names what it measured — a headline
+    # "metric" field, or (the recipe ledger) nested *metrics* tables
+    def _has_metric(node) -> bool:
+        if isinstance(node, dict):
+            return any("metric" in k for k in node) or any(
+                _has_metric(v) for v in node.values()
+            )
+        if isinstance(node, list):
+            return any(_has_metric(v) for v in node)
+        return False
+
+    if not _has_metric(data):
+        errors.append(f"{path}: no metric-naming field (flagship schema)")
+    # a non-TPU measurement must say so: the note is what stops a CPU
+    # number from being read as the committed TPU operating point
+    device = str(
+        data.get("device_kind")
+        or (data.get("summary") or {}).get("device_kind", "")
+        if isinstance(data.get("summary"), dict) else data.get("device_kind")
+        or ""
+    )
+    if device and "tpu" not in device.lower():
+        note = data.get("note") or (
+            (data.get("summary") or {}).get("note", "")
+            if isinstance(data.get("summary"), dict) else ""
+        )
+        if not note:
+            errors.append(
+                f"{path}: non-TPU device_kind {device!r} without the "
+                "TPU-rerun 'note' field"
+            )
+    _walk(path, data, errors)
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    root = argv[1] if len(argv) > 1 else "."
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    if not paths:
+        print(f"bench_gate: no BENCH_*.json under {root!r}", file=sys.stderr)
+        return 1
+    all_errors: list[str] = []
+    for p in paths:
+        all_errors.extend(check_file(p))
+    if all_errors:
+        for e in all_errors:
+            print(f"bench_gate: {e}", file=sys.stderr)
+        print(f"bench_gate: FAIL — {len(all_errors)} violation(s) across "
+              f"{len(paths)} file(s)", file=sys.stderr)
+        return 1
+    print(f"bench_gate: {len(paths)} bench JSON(s) clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -24,14 +24,14 @@ cd "$(dirname "$0")/.."
 python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_comms.py bench_decode.py \
-    bench_recipe.py bench_serving.py \
+    bench_eval.py bench_recipe.py bench_serving.py \
     --fix-check --check-stale --timings --budget 2
 
 # catches syntax errors in files graftlint may not reach (non-.py-suffixed
 # entry points aside, this is the whole tree)
 python -m compileall -q cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_comms.py bench_decode.py \
-    bench_recipe.py bench_serving.py
+    bench_eval.py bench_recipe.py bench_serving.py
 
 # obs_report smoke check: the report CLI must aggregate a known-good run dir
 # without a jax import or backend init (it is part of the operator loop for
@@ -52,6 +52,12 @@ python -m cst_captioning_tpu.cli.obs_report \
 python -m cst_captioning_tpu.cli.obs_report \
     --postmortem tests/fixtures/postmortem_fleet --list > /dev/null
 
+# bench-JSON gate: every committed BENCH_*.json must parse and keep the
+# invariants it promises (parity booleans true, token-match fractions
+# over the tie-noise floor, acceptance measured or machine-checkably
+# skipped, round ledgers rc==0, non-TPU runs carrying the rerun note)
+python scripts/bench_gate.py
+
 # decode fast-path smoke: tiny-dims CPU run of all three decode impls
 # (two-loop / fused one-loop / Pallas kernel) with the fused-vs-two-loop
 # bit-exactness gate inside — keeps bench_decode.py and the kernel from
@@ -68,6 +74,12 @@ JAX_PLATFORMS=cpu python bench_comms.py --smoke > /dev/null
 # engine AND the static-batching reference — asserts goodput > 0 and the
 # served-vs-offline bit-parity block (README "Serving")
 JAX_PLATFORMS=cpu python bench_serving.py --smoke > /dev/null
+
+# eval fast-path smoke: tiny-dims CPU run of the serial/pipelined/NPAD
+# eval ladder with the in-run parity gate inside (lane beam bit-exact vs
+# reference, pipelined metric tables bit-identical to serial, NPAD
+# monotone vs greedy) — README "Eval fast path"
+JAX_PLATFORMS=cpu python bench_eval.py --smoke > /dev/null
 
 # runtime sanitizer smoke: the hot-path tier-1 subset under
 # jax.transfer_guard("disallow") + jax.debug_nans — the empirical half of

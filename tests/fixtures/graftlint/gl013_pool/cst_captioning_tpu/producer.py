@@ -1,0 +1,22 @@
+"""GL013 worker-pool fixture — the DEVICE side.
+
+Same shape as the gl013 pair's producer: ``decode`` returns a device value
+through the jitted ``encode``. The consumer hands it to a thread-pool
+worker that reads it back EXPLICITLY with ``jax.device_get`` — the eval
+pipeline's pattern (eval/evaluator.py) — which must produce ZERO findings.
+
+Deliberately lint-dirty directory: skipped by the repo-wide walk
+(``fixtures`` is in core._SKIP_DIRS), linted explicitly by the tests.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def encode(x):
+    return jnp.tanh(x)
+
+
+def decode(feats):
+    return encode(feats) * 2

@@ -1,0 +1,47 @@
+"""bench_eval.py harness smoke (slow-marked: subprocess + jax compiles).
+
+scripts/lint.sh runs the same ``--smoke`` invocation as a pre-commit gate;
+this test keeps the harness covered from pytest too (``-m slow``) so the
+bench cannot rot into tier-1-green-but-unrunnable. The smoke run itself
+asserts lane-vs-reference beam bit-parity, NPAD monotonicity, and
+pipelined-vs-serial metric bit-identity (it exits nonzero otherwise), so
+rc==0 carries real signal.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_eval_smoke_runs_and_reports():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_eval.py"), "--smoke"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    json_lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert json_lines, proc.stdout[-2000:]
+    out = json.loads(json_lines[-1])
+    assert out["metric"] == "eval_e2e_clips_per_sec_per_chip"
+    assert set(out["modes"]) == {
+        "serial_reference_beam", "pipelined_lanes", "npad_pipelined",
+    }
+    for v in out["modes"].values():
+        assert v > 0
+    assert out["parity"]["lanes_vs_reference_token_exact"] is True
+    assert out["parity"]["lanes_vs_reference_score_bit_exact"] is True
+    assert out["parity"]["npad_best_monotone"] is True
+    assert out["parity"]["pipelined_vs_serial_metrics_bit_identical"] is True
+    assert out["parity_ok"] is True
+    assert 0.0 <= out["overlap"]["fraction_of_scoring_hidden"] <= 1.0
+    # the acceptance field is machine-checkable off-TPU
+    assert out["acceptance"]["vs_committed_475_28"].startswith("skipped")
+    # smoke must not clobber the committed TPU BENCH_EVAL_E2E.json
+    assert "BENCH_EVAL_E2E.json" not in proc.stderr

@@ -438,3 +438,133 @@ def test_fused_stride_under_jit_and_temperature(eos_setup):
 def test_decode_stride_config_validation():
     with pytest.raises(ValueError, match="decode_stride"):
         ModelConfig(decode_stride=0)
+
+
+# ---------------------------------------------------------------------------
+# lane-batched beam (decoding/beam.py beam_impl="lanes") vs the sequential
+# reference — the bit-parity contract the eval fast path rests on
+# ---------------------------------------------------------------------------
+
+
+def test_beam_lanes_matches_reference_bit_exact(setup, eos_setup):
+    """The lane-batched beam is token- AND score-BIT-exact vs the kept
+    ``beam_impl="reference"`` oracle at f32 — same per-lane float programs
+    (vmap over lanes vs flat [B*W] batch), same ``row_logprobs`` spelling,
+    same flattened ``top_k`` — across beam widths, both EOS regimes (the
+    eos_setup rows finish raggedly), and an S-indivisible horizon
+    (max_len=11 exercises the scan boundary T % stride != 0). The tier-1
+    sweep pins the acceptance width (W=5) on both fixtures and spends
+    the ragged-EOS fixture on the remaining axes (scan boundary at 5 and
+    3, the W=1 degenerate beam); the full W x T x fixture product rides
+    the slow-marked exhaustive twin below — each combo is a fresh scan
+    compile, and the product is compile-bound, not assertion-bound."""
+    for fix, combos in (
+        (setup, ((5, T), (3, T))),
+        (eos_setup, ((5, T), (5, 11), (3, 11), (1, T))),
+    ):
+        model, params, feats, masks = fix
+        for W, max_len in combos:
+            ref_tok, ref_sc = beam_search(
+                model, params, feats, masks, beam_size=W, max_len=max_len,
+                beam_impl="reference",
+            )
+            lane_tok, lane_sc = beam_search(
+                model, params, feats, masks, beam_size=W, max_len=max_len,
+                beam_impl="lanes",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(lane_tok), np.asarray(ref_tok)
+            )
+            assert np.asarray(lane_sc).tobytes() == np.asarray(
+                ref_sc
+            ).tobytes(), f"scores not bit-equal at W={W} T={max_len}"
+
+
+@pytest.mark.slow
+def test_beam_lanes_matches_reference_exhaustive(setup, eos_setup):
+    """The full W x max_len x fixture product of the bit-parity pin
+    above (slow: 24 scan compiles)."""
+    for fix in (setup, eos_setup):
+        model, params, feats, masks = fix
+        for W, max_len in itertools.product((1, 3, 5), (T, 11)):
+            ref_tok, ref_sc = beam_search(
+                model, params, feats, masks, beam_size=W, max_len=max_len,
+                beam_impl="reference",
+            )
+            lane_tok, lane_sc = beam_search(
+                model, params, feats, masks, beam_size=W, max_len=max_len,
+                beam_impl="lanes",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(lane_tok), np.asarray(ref_tok)
+            )
+            assert np.asarray(lane_sc).tobytes() == np.asarray(
+                ref_sc
+            ).tobytes(), f"scores not bit-equal at W={W} T={max_len}"
+
+
+def test_beam_lanes_return_all_matches_reference(eos_setup):
+    """``return_all`` surfaces the same W ranked hypotheses from both
+    implementations (tokens exact, scores bit-equal) — the lane layout
+    transpose back to [B, W, T] loses nothing."""
+    model, params, feats, masks = eos_setup
+    ref_tok, ref_sc = beam_search(
+        model, params, feats, masks, beam_size=4, return_all=True,
+        beam_impl="reference",
+    )
+    lane_tok, lane_sc = beam_search(
+        model, params, feats, masks, beam_size=4, return_all=True,
+        beam_impl="lanes",
+    )
+    np.testing.assert_array_equal(np.asarray(lane_tok), np.asarray(ref_tok))
+    assert np.asarray(lane_sc).tobytes() == np.asarray(ref_sc).tobytes()
+
+
+def test_beam_impl_validation():
+    with pytest.raises(ValueError, match="beam_impl"):
+        beam_search(None, None, None, None, beam_impl="bogus")
+
+
+def test_npad_anytime_answer_is_monotone_vs_greedy(setup, eos_setup):
+    """NPAD's best-sum-logprob lane is >= greedy by construction: lane 0
+    IS the greedy rollout and argmax over lane sums can only improve on
+    it (arXiv 1605.03835's anytime property). Pinned on both EOS regimes,
+    one noise temperature each (below and above 1 — each temperature is
+    a fresh rollout compile)."""
+    from cst_captioning_tpu.decoding import npad_decode
+
+    for fix, temps in ((setup, (0.7,)), (eos_setup, (1.3,))):
+        model, params, feats, masks = fix
+        _, g_lp = greedy_decode(model, params, feats, masks)
+        g_sum = np.asarray(g_lp.sum(axis=-1))
+        for temperature in temps:
+            tok, sc = npad_decode(
+                model, params, feats, masks, jax.random.key(3),
+                num_lanes=4, temperature=temperature,
+            )
+            assert tok.shape[0] == B and np.asarray(sc).shape == (B,)
+            assert np.all(np.asarray(sc) >= g_sum - 1e-6), (
+                f"NPAD worse than greedy at temperature={temperature}"
+            )
+            _check_pad_after_eos(tok)
+
+
+def test_npad_low_temperature_collapses_to_greedy(setup):
+    """In the temperature->0 limit every noisy lane decodes the greedy
+    tokens (the ``test_sample_temperature_zero_limit`` contract), their
+    recorded logprob sums coincide with the greedy lane's, and the argmax
+    tie breaks to lane 0 — so NPAD returns exactly the greedy tokens and
+    score. This is the tie-break contract ``npad_best_lane_index`` (and
+    the >=-greedy guarantee) relies on."""
+    from cst_captioning_tpu.decoding import npad_decode
+
+    model, params, feats, masks = setup
+    g_tok, g_lp = greedy_decode(model, params, feats, masks)
+    tok, sc = npad_decode(
+        model, params, feats, masks, jax.random.key(5), num_lanes=3,
+        temperature=1e-4,
+    )
+    np.testing.assert_array_equal(np.asarray(tok), np.asarray(g_tok))
+    np.testing.assert_array_equal(
+        np.asarray(sc), np.asarray(g_lp.sum(axis=-1))
+    )

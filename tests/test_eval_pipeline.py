@@ -1,0 +1,146 @@
+"""Eval fast-path tests: the two-stage decode/score pipeline is bit-identical
+to the serial evaluator (metric table AND captions), the overlap ledger is
+recorded, and the NPAD eval mode runs end to end."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from cst_captioning_tpu import obs
+from cst_captioning_tpu.config.config import EvalConfig, ModelConfig
+from cst_captioning_tpu.data.batcher import Batcher
+from cst_captioning_tpu.data.dataset import CaptionDataset
+from cst_captioning_tpu.data.synthetic import make_synthetic_dataset
+from cst_captioning_tpu.eval.evaluator import Evaluator
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.train.steps import batch_arrays
+
+
+@pytest.fixture(scope="module")
+def eval_setup(tmp_path_factory):
+    out = tmp_path_factory.mktemp("evalpipe")
+    paths = make_synthetic_dataset(
+        str(out), num_videos=12, modalities={"resnet": 16}, max_frames=4,
+        seed=2,
+    )
+    ds = CaptionDataset(
+        paths["info_json"], {"resnet": paths["resnet"]}, "test", 4
+    )
+    cfg = ModelConfig(
+        vocab_size=len(ds.vocab), modalities=(("resnet", 16),), d_embed=12,
+        d_hidden=12, d_att=6, encoder="temporal_attention", max_len=8,
+        max_frames=4, dtype="float32",
+    )
+    model = CaptionModel(cfg)
+    train_ds = CaptionDataset(
+        paths["info_json"], {"resnet": paths["resnet"]}, "train", 4
+    )
+    batch = next(iter(
+        Batcher(train_ds, batch_size=4, max_len=8).epoch(shuffle=False)
+    ))
+    feats, masks, labels, *_ = batch_arrays(batch)
+    params = model.init(jax.random.key(0), feats, masks, labels)
+    return model, params, ds
+
+
+def test_pipelined_matches_serial_bit_identical(eval_setup):
+    """The tentpole contract: the pipelined evaluator's captions (content
+    AND dict order) and metric table are bit-identical to the serial
+    path's — overlap changes WHEN tokenization runs, never its result.
+    Compared through json.dumps so any float drift in any metric fails."""
+    model, params, ds = eval_setup
+    serial = Evaluator(
+        model, ds, EvalConfig(beam_size=3, max_len=8, pipelined=False),
+        batch_size=5,
+    ).evaluate(params)
+    piped = Evaluator(
+        model, ds,
+        EvalConfig(beam_size=3, max_len=8, pipelined=True, score_workers=3),
+        batch_size=5,
+    ).evaluate(params)
+    assert list(piped["captions"]) == list(serial["captions"])
+    assert piped["captions"] == serial["captions"]
+    assert json.dumps(piped["metrics"], sort_keys=True) == json.dumps(
+        serial["metrics"], sort_keys=True
+    )
+
+
+def test_pipelined_beam_reference_impl_matches_lanes(eval_setup):
+    """cfg.beam_impl="reference" routes the sequential oracle through the
+    same evaluator — identical captions (the lane/reference bit-parity
+    contract, observed at the eval surface)."""
+    model, params, ds = eval_setup
+    lanes = Evaluator(
+        model, ds, EvalConfig(beam_size=3, max_len=8), batch_size=5
+    ).evaluate(params)
+    ref = Evaluator(
+        model, ds,
+        EvalConfig(beam_size=3, max_len=8, beam_impl="reference"),
+        batch_size=5,
+    ).evaluate(params)
+    assert ref["captions"] == lanes["captions"]
+
+
+def test_pipelined_records_overlap_ledger(eval_setup, tmp_path):
+    """A pipelined eval leaves the obs ledger behind: stage histograms,
+    overlap gauges, fill/drain spans — and cli.obs_report's builder
+    surfaces them as the eval section."""
+    from cst_captioning_tpu.obs.report import build_report, load_events
+
+    model, params, ds = eval_setup
+    run_dir = str(tmp_path / "run")
+    obs.REGISTRY.reset()  # counters are cumulative; isolate this run
+    obs.configure(run_dir, run="evalpipe")
+    try:
+        Evaluator(
+            model, ds, EvalConfig(beam_size=2, max_len=8), batch_size=5
+        ).evaluate(params)
+    finally:
+        obs.shutdown()
+        obs.REGISTRY.reset()
+    rep = build_report(load_events(run_dir))
+    ev = rep["eval"]
+    assert ev is not None
+    assert ev["batches"] >= 1
+    assert ev["captions"] == len(ds.records)
+    assert ev["decode_total_s"] > 0.0 and ev["score_total_s"] > 0.0
+    assert 0.0 <= ev["overlap_fraction"] <= 1.0
+    assert 0.0 <= ev["overlap_efficiency"] <= 1.0
+    names = {p["phase"] for p in rep["phases"]} | {
+        p["phase"] for p in rep["overlap"]
+    }
+    assert "eval.pipeline.fill" in names
+    assert "eval.pipeline.drain" in names
+
+
+def test_npad_eval_mode_end_to_end(eval_setup):
+    """cfg.npad_lanes switches the evaluator to NPAD anytime decoding:
+    every split video still gets a caption and the metric table is
+    finite — and the run is deterministic (the per-batch rng is
+    fold_in(key(npad_seed), batch_index), carrying no mutable state, so
+    a repeat evaluate — pipeline thread timing and all — reproduces the
+    captions exactly)."""
+    model, params, ds = eval_setup
+    cfg = EvalConfig(
+        beam_size=1, max_len=8, npad_lanes=3, npad_temperature=1.0,
+        npad_seed=7,
+    )
+    ev = Evaluator(model, ds, cfg, batch_size=5)
+    r1 = ev.evaluate(params)
+    r2 = ev.evaluate(params)
+    assert set(r1["captions"]) == {r.video_id for r in ds.records}
+    assert r1["captions"] == r2["captions"]
+    assert all(np.isfinite(v) for v in r1["metrics"].values())
+
+
+def test_eval_config_validation():
+    with pytest.raises(ValueError, match="beam_impl"):
+        EvalConfig(beam_impl="bogus")
+    with pytest.raises(ValueError, match="npad_lanes"):
+        EvalConfig(npad_lanes=-1)
+    with pytest.raises(ValueError, match="npad_temperature"):
+        EvalConfig(npad_lanes=2, npad_temperature=0.0)
+    with pytest.raises(ValueError, match="score_workers"):
+        EvalConfig(score_workers=0)

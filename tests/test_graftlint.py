@@ -1120,6 +1120,15 @@ def test_gl013_single_file_engine_provably_cannot():
     ) == []
 
 
+def test_gl013_worker_pool_explicit_readback_is_clean():
+    """The eval pipeline's cross-thread readback (device tokens submitted
+    to a pool worker that calls ``jax.device_get`` before numpy) must not
+    trip GL013: the explicit transfer is the sanctioned spelling, and
+    ``pool.submit`` is not a host-conversion sink — a function parameter's
+    provenance is unknown, not device."""
+    assert _lint_fixture("gl013_pool", ["GL013"]) == []
+
+
 def test_gl013_branch_sensitive_no_false_positive(tmp_path):
     """A host rebinding in one branch must not inherit the other branch's
     device provenance (the real scst.py seam pattern)."""
