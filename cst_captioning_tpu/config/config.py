@@ -235,6 +235,11 @@ class TrainConfig:
                     f"spike_factor_min {self.spike_factor_min} must be in "
                     f"(0, spike_factor={self.spike_factor}]"
                 )
+        if self.rl_topology not in ("sync", "decoupled"):
+            raise ValueError(
+                f"unknown rl_topology {self.rl_topology!r} "
+                "(expected 'sync' or 'decoupled')"
+            )
     # per-step JSONL events (loss/reward + grad_norm every N steps; 0 = off,
     # keeping logs to per-epoch summaries)
     log_every_steps: int = 0
@@ -316,6 +321,16 @@ class TrainConfig:
     # grad-norm/reward/step-time streams; verdicts land inline in the ring
     # records and as `anomaly` events + obs.anomaly.<kind> counters
     anomaly: bool = False
+    # ---- RL actor/learner topology (rl/async_scst.py; README "Decoupled
+    # actor/learner RL"): "sync" (default) = today's synchronous loop,
+    # bit-identical to the pre-topology trainer. "decoupled" = the data mesh
+    # splits into actor and learner submeshes (rl.actor_fraction) — actors
+    # run the fused decode continuously into a device-resident rollout ring
+    # (rl.rollout_depth), learners consume it with the existing rl_update
+    # factories, and params broadcast actor-ward on the rl.staleness_bound
+    # schedule. Decoupled with depth 1 / bound 0 / actor = full mesh is the
+    # strict replay mode, pinned bit-identical to "sync"
+    rl_topology: str = "sync"
 
 
 @dataclass(frozen=True)
@@ -358,6 +373,22 @@ class RLConfig:
     # on K/C rollouts at a time — the same total gradient up to float
     # summation order, NOT bit-equal to the fused path (1 = fused)
     update_chunks: int = 1
+    # ---- decoupled actor/learner knobs (train.rl_topology="decoupled";
+    # rl/async_scst.py, README "Decoupled actor/learner RL") ----
+    # device-resident rollout ring depth in batches: actors decode up to
+    # this many batches ahead of the learner (2 = the double buffer).
+    # Depth 1 serializes actor and learner — with staleness_bound 0 and a
+    # full-mesh actor that is the strict schedule replaying "sync" bit-for-bit
+    rollout_depth: int = 2
+    # max learner updates a rollout's params may lag at consumption time; a
+    # staler rollout is dropped and re-decoded (recounted) under the actor's
+    # current params with the entry's stored RNG key, so the drop/recount
+    # sequence is deterministic run-to-run
+    staleness_bound: int = 1
+    # fraction of the data-axis devices handed to the actor submesh (the
+    # remainder learn); both sides are clamped to >= 1 device, and a 1-device
+    # mesh (or mesh=None) runs both roles on the same device
+    actor_fraction: float = 0.5
 
 
 @dataclass(frozen=True)
@@ -473,6 +504,30 @@ class ExperimentConfig:
                 f"chunk boundary is the overlap seam; got "
                 f"{self.rl.update_chunks})"
             )
+        if self.train.rl_topology == "decoupled":
+            if self.rl.rollout_depth < 1:
+                raise ValueError(
+                    f"rl.rollout_depth {self.rl.rollout_depth} must be >= 1 "
+                    "for train.rl_topology='decoupled'"
+                )
+            if self.rl.staleness_bound < 0:
+                raise ValueError(
+                    f"rl.staleness_bound {self.rl.staleness_bound} must be "
+                    ">= 0 (0 = strict on-policy consumption)"
+                )
+            if not 0.0 < self.rl.actor_fraction < 1.0:
+                raise ValueError(
+                    f"rl.actor_fraction {self.rl.actor_fraction} must be in "
+                    "(0, 1) — both submeshes need at least one device's share"
+                )
+            if self.mesh.seq_devices > 1:
+                # the SP trainer's decode/update live inside one shard_map
+                # over ('data','seq'); splitting 'data' under it needs a
+                # submesh-aware SP story first
+                raise ValueError(
+                    "train.rl_topology='decoupled' is not implemented for "
+                    "the sequence-parallel ('seq_devices > 1') path"
+                )
         if self.mesh.seq_devices > 1 and (
             self.train.comm_dtype != "f32" or self.train.comm_overlap
         ):

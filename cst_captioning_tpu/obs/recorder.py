@@ -64,10 +64,12 @@ from cst_captioning_tpu.obs.span import (
 _PROBE_GAUGES = (
     "comm.bytes_on_wire", "comm.buckets", "health.peers_alive",
     "health.peer_age_max_s", "serving.slo.burn_rate.60s",
+    "rl.actor.occupancy", "rl.learner.occupancy",
 )
 _PROBE_COUNTERS = (
     "rl.decode.compaction.lanes_stepped",
     "rl.decode.compaction.lanes_skipped",
+    "rl.staleness.dropped", "rl.actor.preempted",
     "resilience.nan_skip", "resilience.rollback", "resilience.chaos_fault",
     "health.peer_lost",
 )

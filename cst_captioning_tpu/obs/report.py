@@ -54,7 +54,8 @@ _PROC_DIR_RE = re.compile(r"^proc(\d+)$")
 # canonical phase order for the table; unknown names sort after, by total
 _PHASE_ORDER = (
     "setup", "xe.epoch", "xe.step", "rl.epoch", "rl.decode", "rl.reward",
-    "rl.update", "eval", "eval.pipeline.fill", "eval.pipeline.drain",
+    "rl.update", "rl.actor.decode", "rl.actor.broadcast", "rl.learner.step",
+    "eval", "eval.pipeline.fill", "eval.pipeline.drain",
     "eval.score", "serving.admit", "serving.encode",
     "serving.stride", "serving.detok", "ckpt", "ckpt.save", "ckpt.restore",
     "dcn.collective", "degraded_rendezvous", "prefetch.stage",
@@ -330,6 +331,31 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
             "drain_s": gauges.get("eval.pipeline.drain_s", 0.0),
         }
 
+    # decoupled actor/learner RL (rl/async_scst.py): throughput counters,
+    # host-observed occupancy gauges, and the staleness-in-updates
+    # histogram. None when the run never used train.rl_topology="decoupled".
+    rl_async = None
+    stale = histograms.get("rl.staleness")
+    if counters.get("rl.actor.batches") or counters.get("rl.learner.steps") \
+            or (stale and stale.get("count")):
+        rl_async = {
+            "actor_batches": counters.get("rl.actor.batches", 0),
+            "learner_steps": counters.get("rl.learner.steps", 0),
+            "dropped_stale": counters.get("rl.staleness.dropped", 0),
+            "actor_preemptions": counters.get("rl.actor.preempted", 0),
+            "actor_occupancy": gauges.get("rl.actor.occupancy"),
+            "learner_occupancy": gauges.get("rl.learner.occupancy"),
+            "staleness_mean": (
+                stale["sum"] / stale["count"]
+                if stale and stale.get("count") else 0.0
+            ),
+            "staleness_p95": (
+                _hist_quantile(stale, 0.95)
+                if stale and stale.get("count") else 0.0
+            ),
+            "staleness_max": (stale or {}).get("max", 0.0),
+        }
+
     resilience = {
         "nan_skips": counters.get("resilience.nan_skip", 0),
         "divergences": sum(
@@ -388,6 +414,7 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
         "decode": decode,
         "serving": serving,
         "eval": eval_sec,
+        "rl_async": rl_async,
         "resilience": resilience,
         "health": health,
         "compile": {
@@ -549,6 +576,29 @@ def render_report(report: dict[str, Any]) -> str:
             f"hidden under decode (efficiency "
             f"{100.0 * ev['overlap_efficiency']:.1f}% of the hideable "
             f"stage)   fill {ev['fill_s']:.3f}s   drain {ev['drain_s']:.3f}s"
+        )
+    ra = report.get("rl_async")
+    if ra:
+        lines.append("")
+        occ_bits = "   ".join(
+            f"{role} occupancy {100.0 * v:.1f}%"
+            for role, v in (
+                ("actor", ra.get("actor_occupancy")),
+                ("learner", ra.get("learner_occupancy")),
+            )
+            if v is not None
+        )
+        lines.append(
+            f"actor/learner: {int(ra['actor_batches'])} rollout batch(es) "
+            f"decoded, {int(ra['learner_steps'])} learner step(s)"
+            + (f"   {occ_bits}" if occ_bits else "")
+        )
+        lines.append(
+            f"  staleness (updates): mean {ra['staleness_mean']:.2f}   "
+            f"p95 {ra['staleness_p95']:.2f}   max "
+            f"{ra['staleness_max']:.0f}   dropped+recounted: "
+            f"{int(ra['dropped_stale'])}   actor preemptions: "
+            f"{int(ra['actor_preemptions'])}"
         )
     r = report["resilience"]
     lines.append("")

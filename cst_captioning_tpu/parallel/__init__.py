@@ -16,6 +16,13 @@ from cst_captioning_tpu.parallel.comms import (
     plan_buckets,
     reduce_tree,
 )
+from cst_captioning_tpu.parallel.submesh import (
+    SubmeshPlan,
+    largest_divisor,
+    plan_submesh,
+    shared_plan,
+    shrink_actors,
+)
 from cst_captioning_tpu.parallel.seq_parallel import (
     make_sp_decode,
     make_sp_forward,
@@ -30,7 +37,12 @@ __all__ = [
     "Bucket",
     "BucketPlan",
     "CommConfig",
+    "SubmeshPlan",
+    "largest_divisor",
     "ledger",
+    "plan_submesh",
+    "shared_plan",
+    "shrink_actors",
     "make_sp_decode",
     "per_leaf_f32_bytes",
     "plan_buckets",

@@ -1,0 +1,139 @@
+"""Actor/learner submesh partitioning for decoupled SCST.
+
+``train.rl_topology="decoupled"`` (rl/async_scst.py) splits the 1-D data
+mesh into two disjoint submeshes: ACTOR devices run the fused rollout
+decode continuously, LEARNER devices consume the rollout ring with the
+REINFORCE update. Each submesh is a real ``Mesh`` over the same axis name,
+so the existing shard_map decode/update factories work on either side
+unchanged — the factories only see "a mesh with a 'data' axis".
+
+Two constraints shape the split:
+
+- both submeshes need >= 1 device (a 1-device mesh degenerates to a SHARED
+  plan: the one device plays both roles, which is also the mesh=None and
+  strict-replay layout);
+- each side's device count must divide the global batch (batch rows shard
+  over the submesh axis), so counts are clamped DOWN to the largest divisor
+  of the batch size — the same rule reclamps survivors after an
+  ``actor_preempt`` fault shrinks the actor side.
+
+Cross-submesh movement (finished rollouts actor->learner, fresh params
+learner->actor) is a plain ``jax.device_put`` onto the other submesh's
+``NamedSharding`` — resharding between device sets, no collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+from jax.sharding import Mesh
+
+
+def largest_divisor(batch: int, upper: int) -> int:
+    """Largest d with 1 <= d <= upper and batch % d == 0 (1 always works)."""
+    upper = max(1, upper)
+    if batch <= 0:
+        return upper
+    for d in range(min(upper, batch), 0, -1):
+        if batch % d == 0:
+            return d
+    return 1
+
+
+@dataclass(frozen=True)
+class SubmeshPlan:
+    """The actor/learner split of a data mesh.
+
+    ``shared`` marks the degenerate layout where one submesh IS the full
+    mesh and both roles run on the same devices (1-device meshes, and the
+    strict replay mode which pins bit-identity by decoding on the full
+    mesh exactly like the sync loop).
+    """
+
+    actor: Mesh
+    learner: Mesh
+    actor_devices: tuple
+    learner_devices: tuple
+    shared: bool
+
+    @property
+    def n_actors(self) -> int:
+        return len(self.actor_devices)
+
+    @property
+    def n_learners(self) -> int:
+        return len(self.learner_devices)
+
+
+def _submesh(devices, axis: str) -> Mesh:
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def shared_plan(mesh: Mesh, axis: str = "data") -> SubmeshPlan:
+    """Both roles on the full mesh (strict replay / 1-device layout)."""
+    devs = tuple(mesh.devices.reshape(-1))
+    return SubmeshPlan(mesh, mesh, devs, devs, shared=True)
+
+
+def plan_submesh(
+    mesh: Mesh,
+    actor_fraction: float = 0.5,
+    axis: str = "data",
+    batch_size: int = 0,
+) -> SubmeshPlan:
+    """Partition ``mesh`` into disjoint actor/learner submeshes.
+
+    The actor side takes ``round(n * actor_fraction)`` devices clamped so
+    both sides keep >= 1, then each side clamps down to the largest
+    divisor of ``batch_size`` (0 = no batch constraint). A mesh with a
+    single device returns the shared plan.
+    """
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            f"plan_submesh needs a 1-D mesh, got axes {mesh.axis_names!r}"
+        )
+    devices = list(mesh.devices.reshape(-1))
+    n = len(devices)
+    if n < 2:
+        return shared_plan(mesh, axis=axis)
+    n_actor = max(1, min(n - 1, round(n * actor_fraction)))
+    n_actor = largest_divisor(batch_size, n_actor)
+    n_learner = largest_divisor(batch_size, n - n_actor)
+    actors = tuple(devices[:n_actor])
+    learners = tuple(devices[n_actor:n_actor + n_learner])
+    return SubmeshPlan(
+        actor=_submesh(actors, axis),
+        learner=_submesh(learners, axis),
+        actor_devices=actors,
+        learner_devices=learners,
+        shared=False,
+    )
+
+
+def shrink_actors(
+    plan: SubmeshPlan,
+    drop_index: int,
+    axis: str = "data",
+    batch_size: int = 0,
+) -> SubmeshPlan | None:
+    """Remove one actor device (an ``actor_preempt`` casualty) from the plan.
+
+    ``drop_index`` indexes the CURRENT actor device list modulo its length,
+    mirroring how chaos faults address phantom hosts. Survivors reclamp to
+    the largest batch divisor. Returns ``None`` when no actor survives —
+    the caller falls back to the sync schedule on the learner submesh.
+    """
+    if plan.shared or plan.n_actors <= 1:
+        return None
+    survivors = list(plan.actor_devices)
+    del survivors[drop_index % len(survivors)]
+    keep = largest_divisor(batch_size, len(survivors))
+    survivors = tuple(survivors[:keep])
+    return SubmeshPlan(
+        actor=_submesh(survivors, axis),
+        learner=plan.learner,
+        actor_devices=survivors,
+        learner_devices=plan.learner_devices,
+        shared=False,
+    )

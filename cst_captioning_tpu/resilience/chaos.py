@@ -40,6 +40,12 @@ Fault kinds:
   new admissions, and persists the queue + page-table snapshot — the
   SIGTERM/peer-loss path, triggered deterministically. The recovery test
   replays the drained queue and pins bit-identical tokens.
+- ``"actor_preempt"`` — preempt one device of the decoupled RL actor
+  submesh (fire at ``rl.actor.step``; ``host`` indexes the victim device
+  in the actor plan). The running
+  :class:`~cst_captioning_tpu.rl.async_scst.AsyncSCSTTrainer` epoch sheds
+  the device, recounts the in-flight rollout ring on the survivors, and
+  falls back to the sync schedule when no actor remains.
 
 Injection points currently compiled in:
 
@@ -55,6 +61,7 @@ Injection points currently compiled in:
 ``ckpt.pre_replace``    tmp dir complete + fsync'd, final rename not yet done
 ``reward.call``    inside the retried RL reward invocation
 ``serving.step``   serving admission loop, once per iteration (main thread)
+``rl.actor.step``  decoupled RL actor loop, once per decoded batch
 =================  =========================================================
 """
 
@@ -107,7 +114,7 @@ class Fault:
 
     _KINDS = ("kill", "preempt", "io_error", "nan", "slow", "slow_h2d",
               "partial_h2d", "wedged_prefetch", "enospc_rotation",
-              "partial_preempt", "serving_preempt")
+              "partial_preempt", "serving_preempt", "actor_preempt")
 
     def __post_init__(self):
         if self.kind not in self._KINDS:
@@ -217,6 +224,11 @@ class FaultPlan:
                 from cst_captioning_tpu.serving import engine as serving
 
                 serving.request_drain("chaos_serving_preempt")
+            elif f.kind == "actor_preempt":
+                # lazy import: rl pulls jax in, same contract as serving
+                from cst_captioning_tpu.rl import async_scst
+
+                async_scst.request_actor_preempt(f.host)
             elif f.kind in ("slow", "slow_h2d", "wedged_prefetch"):
                 time.sleep(f.delay)
             elif f.kind == "nan":

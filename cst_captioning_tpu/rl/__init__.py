@@ -8,6 +8,12 @@ a second jitted program re-scores the sampled tokens differentiably and
 applies the REINFORCE update (with psum-DP over the mesh).
 """
 
+from cst_captioning_tpu.rl.async_scst import (
+    AsyncSCSTTrainer,
+    RolloutRing,
+    make_actor_decode,
+    request_actor_preempt,
+)
 from cst_captioning_tpu.rl.rewards import RewardComputer, scb_baseline
 from cst_captioning_tpu.rl.scst import (
     SCSTTrainer,
@@ -18,11 +24,15 @@ from cst_captioning_tpu.rl.scst import (
 )
 
 __all__ = [
+    "AsyncSCSTTrainer",
     "RewardComputer",
+    "RolloutRing",
     "scb_baseline",
     "SCSTTrainer",
+    "make_actor_decode",
     "make_rl_decode",
     "make_parallel_rl_decode",
     "make_rl_update",
     "make_parallel_rl_update",
+    "request_actor_preempt",
 ]

@@ -1911,7 +1911,14 @@ class _DonationFlow:
     use-after-donate: at runtime the buffer is deleted and the read
     raises. Loop bodies are walked twice so a donation on iteration one
     is visible to reads on iteration two (the classic un-rebound
-    ``new_state = step(state, b)`` train-loop bug)."""
+    ``new_state = step(state, b)`` train-loop bug).
+
+    Buffers and callees can both be ATTRIBUTE-rooted: ``self._buf`` donated
+    through ``self._write`` (a ``self.``/``cls.``-stripped method resolved
+    via the project index) is tracked under its dotted name, so the
+    donate-and-rebind ring-buffer idiom
+    ``self._buf = self._write(self._buf, x)`` stays clean while a missing
+    rebind flags (rl/async_scst.py's RolloutRing is the in-tree shape)."""
 
     def __init__(self, rule: "DonationFlowRule", ctx: FileContext,
                  index: ProjectIndex, aliases: dict[str, str]):
@@ -1940,6 +1947,11 @@ class _DonationFlow:
         local = self.donating.get(dotted)
         if local is not None:
             return local
+        # attribute-rooted callees: `self._write(...)` resolves to the
+        # enclosing (or any unique) class's method — the index keys are
+        # `module.Class.method`, which `self.`/`cls.` can never prefix
+        if dotted.startswith(("self.", "cls.")):
+            dotted = dotted.split(".", 1)[1]
         if _last(dotted) not in self.index.donation_names:
             return None  # no donating function anywhere shares the name
         resolved = resolve_dotted(dotted, self.aliases)
@@ -1964,6 +1976,8 @@ class _DonationFlow:
 
     def _factory_donation(self, call: ast.Call) -> tuple | None:
         dotted = _dotted(call.func)
+        if dotted.startswith(("self.", "cls.")):
+            dotted = dotted.split(".", 1)[1]
         if _last(dotted) not in self.index.donation_names:
             return None
         resolved = resolve_dotted(dotted, self.aliases)
@@ -2059,13 +2073,23 @@ class _DonationFlow:
         for node in ast.walk(expr):
             if isinstance(node, ast.Call):
                 calls.append(node)
-            elif isinstance(node, ast.Name) and isinstance(
+                continue
+            read = None
+            if isinstance(node, ast.Name) and isinstance(
                 node.ctx, ast.Load
-            ) and node.id in self.donated:
-                line, label = self.donated[node.id]
+            ):
+                read = node.id
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                # attribute-rooted buffers (`self._state`-style) donate and
+                # read under their dotted name
+                read = _dotted(node)
+            if read and read in self.donated:
+                line, label = self.donated[read]
                 self._report(
                     node,
-                    f"buffer {node.id!r} was donated on line {line} "
+                    f"buffer {read!r} was donated on line {line} "
                     f"(to {label}) and is read again here: donation "
                     "deletes the buffer, so this read raises at runtime "
                     "— reorder the read before the donating call, or "
@@ -2077,12 +2101,17 @@ class _DonationFlow:
                 continue
             positions, label = don
             for pos in positions:
-                if pos < len(node.args) and isinstance(
-                    node.args[pos], ast.Name
-                ):
-                    name = node.args[pos].id
-                    if name not in self.donated:
-                        self.donated[name] = (node.lineno, label)
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                if isinstance(arg, ast.Name):
+                    name = arg.id
+                elif isinstance(arg, ast.Attribute):
+                    name = _dotted(arg)
+                else:
+                    continue
+                if name and name not in self.donated:
+                    self.donated[name] = (node.lineno, label)
 
     def _report(self, node: ast.AST, message: str) -> None:
         key = (node.lineno, node.col_offset)

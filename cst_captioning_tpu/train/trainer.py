@@ -68,7 +68,7 @@ from cst_captioning_tpu.resilience.sentinel import (
     RollbackRequested,
     TrainingDiverged,
 )
-from cst_captioning_tpu.rl import RewardComputer, SCSTTrainer
+from cst_captioning_tpu.rl import AsyncSCSTTrainer, RewardComputer, SCSTTrainer
 from cst_captioning_tpu.train import multihost
 from cst_captioning_tpu.train.mesh import batch_sharding, make_mesh, replicate
 from cst_captioning_tpu.train.schedule import make_optimizer
@@ -455,16 +455,37 @@ class Trainer:
 
     @staticmethod
     def _seam_bytes(seam: dict, epoch: int, batch_index: int) -> bytes:
-        """Serialize a captured seam (scst._seam_capture output) + its
-        position as an npz blob for the checkpoint's extra_files."""
+        """Serialize a captured seam (scst._seam_capture output, or the
+        decoupled loop's in-flight ring) + its position as an npz blob for
+        the checkpoint's extra_files."""
         arrays = {
-            "samples": np.asarray(seam["samples"]),
-            "video_ids": np.asarray([str(v) for v in seam["video_ids"]]),
             "epoch": np.asarray(int(epoch)),
             "batch_index": np.asarray(int(batch_index)),
         }
-        if seam.get("greedy") is not None:
-            arrays["greedy"] = np.asarray(seam["greedy"])
+        if "ring" in seam:
+            # decoupled drain: every in-flight rollout ring entry persists
+            # (tokens + logprobs + RNG key data), flattened as ring{i}_*
+            # entries are already host arrays (the capture device_gets);
+            # np.savez converts the list/int leaves itself
+            arrays["ring_n"] = len(seam["ring"])
+            for i, e in enumerate(seam["ring"]):
+                arrays[f"ring{i}_samples"] = e["samples"]
+                arrays[f"ring{i}_lps"] = e["lps"]
+                arrays[f"ring{i}_video_ids"] = [
+                    str(v) for v in e["video_ids"]
+                ]
+                arrays[f"ring{i}_valid"] = e["valid"]
+                arrays[f"ring{i}_rng"] = e["rng"]
+                arrays[f"ring{i}_batch_index"] = int(e["batch_index"])
+                if e.get("greedy") is not None:
+                    arrays[f"ring{i}_greedy"] = e["greedy"]
+        else:
+            arrays["samples"] = np.asarray(seam["samples"])
+            arrays["video_ids"] = np.asarray(
+                [str(v) for v in seam["video_ids"]]
+            )
+            if seam.get("greedy") is not None:
+                arrays["greedy"] = np.asarray(seam["greedy"])
         buf = io.BytesIO()
         np.savez(buf, **arrays)
         return buf.getvalue()
@@ -481,16 +502,39 @@ class Trainer:
             return None
         try:
             with np.load(path, allow_pickle=False) as z:
-                seam = {
-                    "samples": np.asarray(z["samples"]),
-                    "greedy": (
-                        np.asarray(z["greedy"]) if "greedy" in z.files
-                        else None
-                    ),
-                    "video_ids": [str(v) for v in z["video_ids"]],
-                    "epoch": int(z["epoch"]),
-                    "batch_index": int(z["batch_index"]),
-                }
+                if "ring_n" in z.files:
+                    # npz members load as host ndarrays already
+                    ring = []
+                    for i in range(int(z["ring_n"])):
+                        e = {
+                            "samples": z[f"ring{i}_samples"],
+                            "lps": z[f"ring{i}_lps"],
+                            "video_ids": [
+                                str(v) for v in z[f"ring{i}_video_ids"]
+                            ],
+                            "valid": z[f"ring{i}_valid"],
+                            "rng": z[f"ring{i}_rng"],
+                            "batch_index": int(z[f"ring{i}_batch_index"]),
+                        }
+                        if f"ring{i}_greedy" in z.files:
+                            e["greedy"] = z[f"ring{i}_greedy"]
+                        ring.append(e)
+                    seam = {
+                        "ring": ring,
+                        "epoch": int(z["epoch"]),
+                        "batch_index": int(z["batch_index"]),
+                    }
+                else:
+                    seam = {
+                        "samples": np.asarray(z["samples"]),
+                        "greedy": (
+                            np.asarray(z["greedy"]) if "greedy" in z.files
+                            else None
+                        ),
+                        "video_ids": [str(v) for v in z["video_ids"]],
+                        "epoch": int(z["epoch"]),
+                        "batch_index": int(z["batch_index"]),
+                    }
         except (OSError, ValueError, KeyError) as e:
             # a torn/legacy seam degrades to the old re-decode behavior —
             # never to a crash or to silently wrong tokens
@@ -1095,13 +1139,24 @@ class Trainer:
         def build_scst():
             """SCST step closures + batcher for the CURRENT mesh — rebuilt
             after a degraded-mesh continuation shrinks it."""
-            scst = SCSTTrainer(
-                self.model, reward, cfg.rl, mesh=self.mesh,
-                max_len=cfg.model.max_len, donate=True, guard=self.guard,
-                on_event=self.log.log,
-                comm=CommConfig.from_train(cfg.train),
-                stats=self._stats,
-            )
+            if cfg.train.rl_topology == "decoupled":
+                # actor/learner split epoch schedule (rl/async_scst.py);
+                # batch_size clamps the submesh split to batch divisors
+                scst = AsyncSCSTTrainer(
+                    self.model, reward, cfg.rl, mesh=self.mesh,
+                    max_len=cfg.model.max_len, donate=True,
+                    guard=self.guard, on_event=self.log.log,
+                    comm=CommConfig.from_train(cfg.train),
+                    stats=self._stats, batch_size=cfg.data.batch_size,
+                )
+            else:
+                scst = SCSTTrainer(
+                    self.model, reward, cfg.rl, mesh=self.mesh,
+                    max_len=cfg.model.max_len, donate=True, guard=self.guard,
+                    on_event=self.log.log,
+                    comm=CommConfig.from_train(cfg.train),
+                    stats=self._stats,
+                )
             rl_batcher = Batcher(
                 self.train_ds,
                 batch_size=cfg.data.batch_size,
@@ -1167,9 +1222,12 @@ class Trainer:
         # the uninterrupted schedule. Anything else (position mismatch,
         # strict pipeline off) falls back to the old re-decode.
         seam = None
+        seam_capable = (
+            cfg.rl.pipelined or cfg.train.rl_topology == "decoupled"
+        )
         if skip and self._pending_seam is not None:
             cand, self._pending_seam = self._pending_seam, None
-            if cfg.rl.pipelined and cand["epoch"] == self.epoch \
+            if seam_capable and cand["epoch"] == self.epoch \
                     and cand["batch_index"] == skip:
                 seam = cand
             else:
@@ -1261,7 +1319,7 @@ class Trainer:
                         self.health is not None and self.health.peer_lost
                     ),
                     seam=seam,
-                    seam_sink=seam_sink if cfg.rl.pipelined else None,
+                    seam_sink=seam_sink if seam_capable else None,
                 )
             finally:
                 stop.set()

@@ -143,8 +143,39 @@ def check_file(path: str) -> list[str]:
                 f"{path}: non-TPU device_kind {device!r} without the "
                 "TPU-rerun 'note' field"
             )
+    if name == "BENCH_RL_ASYNC.json":
+        _check_rl_async(path, data, errors)
     _walk(path, data, errors)
     return errors
+
+
+def _check_rl_async(path: str, data: dict, errors: list[str]) -> None:
+    """The decoupled-RL ledger's own promises beyond the generic schema:
+    the strict rung proves the replay (the parity block carries all three
+    strict_* pins — _check_parity then enforces they are true), and the
+    decoupled rung carries its async evidence (staleness histogram,
+    dropped/recounted count, actor+learner occupancy)."""
+    parity = data.get("parity")
+    if not isinstance(parity, dict):
+        errors.append(f"{path}: missing the strict parity block")
+    else:
+        for k in ("strict_params_bit_exact", "strict_scored_tokens_bit_exact",
+                  "strict_nothing_dropped"):
+            if k not in parity:
+                errors.append(f"{path}: parity block missing {k!r}")
+    rung = (data.get("rungs") or {}).get("decoupled")
+    if not isinstance(rung, dict):
+        errors.append(f"{path}: missing the 'decoupled' rung")
+        return
+    if not isinstance(rung.get("staleness_histogram"), dict):
+        errors.append(f"{path}: decoupled rung missing staleness_histogram")
+    if not isinstance(rung.get("dropped_stale"), int):
+        errors.append(f"{path}: decoupled rung missing dropped_stale")
+    occ = rung.get("occupancy")
+    if not isinstance(occ, dict) or not {"actor", "learner"} <= set(occ):
+        errors.append(
+            f"{path}: decoupled rung occupancy must carry actor + learner"
+        )
 
 
 def main(argv: list[str]) -> int:

@@ -24,14 +24,14 @@ cd "$(dirname "$0")/.."
 python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_comms.py bench_decode.py \
-    bench_eval.py bench_recipe.py bench_serving.py \
+    bench_eval.py bench_recipe.py bench_rl_async.py bench_serving.py \
     --fix-check --check-stale --timings --budget 2
 
 # catches syntax errors in files graftlint may not reach (non-.py-suffixed
 # entry points aside, this is the whole tree)
 python -m compileall -q cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_comms.py bench_decode.py \
-    bench_eval.py bench_recipe.py bench_serving.py
+    bench_eval.py bench_recipe.py bench_rl_async.py bench_serving.py
 
 # obs_report smoke check: the report CLI must aggregate a known-good run dir
 # without a jax import or backend init (it is part of the operator loop for
@@ -74,6 +74,12 @@ JAX_PLATFORMS=cpu python bench_comms.py --smoke > /dev/null
 # engine AND the static-batching reference — asserts goodput > 0 and the
 # served-vs-offline bit-parity block (README "Serving")
 JAX_PLATFORMS=cpu python bench_serving.py --smoke > /dev/null
+
+# decoupled-RL smoke: tiny-dims CPU run of the sync/strict/decoupled
+# topology ladder through the real train_epoch, with the strict-parity
+# gate inside (ring replay bit-identical to the sync schedule: params AND
+# every scored token row) — README "Decoupled actor/learner RL"
+JAX_PLATFORMS=cpu python bench_rl_async.py --smoke > /dev/null
 
 # eval fast-path smoke: tiny-dims CPU run of the serial/pipelined/NPAD
 # eval ladder with the in-run parity gate inside (lane beam bit-exact vs

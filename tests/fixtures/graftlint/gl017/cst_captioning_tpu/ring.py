@@ -1,0 +1,38 @@
+"""GL017 attribute-rooted fixture — ``self._buf``-style buffers donated
+through method calls (the rl/async_scst.py RolloutRing shape).
+
+``Ring._write`` is a donating staticmethod; ``bad_push`` donates
+``self._buf`` through it and re-reads the attribute WITHOUT rebinding —
+the use-after-donate. ``good_push`` rebinds (donate-and-rebind is THE
+pattern) and must stay clean, as must ``good_read_first``.
+
+Deliberately lint-dirty directory: skipped by the repo-wide walk
+(``fixtures`` is in core._SKIP_DIRS), linted explicitly by the tests.
+"""
+
+import functools
+
+import jax
+
+
+class Ring:
+    def __init__(self, buf):
+        self._buf = buf
+
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _write(buf, update, slot):
+        return buf.at[slot].set(update)
+
+    def bad_push(self, update, slot):
+        out = self._write(self._buf, update, slot)
+        return out, self._buf.shape  # GL017: self._buf donated, not rebound
+
+    def good_push(self, update, slot):
+        self._buf = self._write(self._buf, update, slot)
+        return self._buf.shape
+
+    def good_read_first(self, update, slot):
+        shape = self._buf.shape
+        out = self._write(self._buf, update, slot)
+        return out, shape

@@ -1,0 +1,468 @@
+"""Decoupled actor/learner SCST tests: submesh planning, strict-mode
+bit-identity against the sync loop, staleness drop/recount determinism,
+drain/resume of the in-flight rollout ring, and the zero-actor fallback."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config.config import ModelConfig, RLConfig, TrainConfig
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.parallel import (
+    largest_divisor,
+    plan_submesh,
+    shared_plan,
+    shrink_actors,
+)
+from cst_captioning_tpu.rl import AsyncSCSTTrainer, SCSTTrainer
+from cst_captioning_tpu.train import (
+    create_train_state,
+    make_mesh,
+    make_optimizer,
+    replicate,
+    shard_batch,
+)
+
+V = 14
+B, F, T = 8, 3, 5
+
+
+@pytest.fixture(scope="module")
+def model_setup():
+    cfg = ModelConfig(
+        vocab_size=V,
+        modalities=(("resnet", 6),),
+        d_embed=12,
+        d_hidden=12,
+        d_att=6,
+        encoder="meanpool",
+        dropout=0.0,
+        max_len=T,
+        max_frames=F,
+        dtype="float32",
+    )
+    model = CaptionModel(cfg)
+    rng = np.random.default_rng(0)
+    feats = {"resnet": jnp.asarray(rng.normal(size=(B, F, 6)), jnp.float32)}
+    masks = {"resnet": jnp.ones((B, F), jnp.float32)}
+    labels = jnp.asarray(rng.integers(4, V, size=(B, T)), jnp.int32)
+    tx = make_optimizer(TrainConfig(lr=5e-2, grad_clip=5.0), 10)
+    state = create_train_state(model, tx, (feats, masks, labels), seed=1)
+    return model, state, feats, masks
+
+
+class TokenReward:
+    """Rigged reward: +1 per occurrence of a target token. ``calls``
+    records every scored row batch so tests can pin token bit-identity
+    between two schedules without reaching into the decode."""
+
+    def __init__(self, target: int):
+        self.target = target
+        self.calls: list[np.ndarray] = []
+
+    def __call__(self, video_ids, rows):
+        rows = np.asarray(rows)
+        self.calls.append(rows.copy())
+        return (rows == self.target).sum(axis=1).astype(np.float32)
+
+
+VIDS = [f"v{i}" for i in range(B)]
+
+
+def _batches(feats, masks, n):
+    return [(feats, masks, VIDS, None)] * n
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---- submesh planning -------------------------------------------------------
+
+
+def test_largest_divisor():
+    assert largest_divisor(8, 3) == 2
+    assert largest_divisor(8, 4) == 4
+    assert largest_divisor(6, 4) == 3
+    assert largest_divisor(7, 4) == 1
+    assert largest_divisor(0, 5) == 5  # no batch constraint
+    assert largest_divisor(8, 0) == 1
+
+
+def test_plan_submesh_halves_and_clamps():
+    mesh = make_mesh()
+    n = mesh.devices.size
+    plan = plan_submesh(mesh, 0.5, batch_size=8)
+    assert not plan.shared
+    assert plan.n_actors + plan.n_learners <= n
+    assert plan.n_actors >= 1 and plan.n_learners >= 1
+    assert 8 % plan.n_actors == 0 and 8 % plan.n_learners == 0
+    assert set(plan.actor_devices).isdisjoint(plan.learner_devices)
+    # each side is a real 1-axis mesh over the same axis name
+    assert plan.actor.axis_names == plan.learner.axis_names == ("data",)
+
+
+def test_plan_submesh_single_device_is_shared():
+    dev = jax.devices()[0]
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray([dev]), ("data",))
+    plan = plan_submesh(mesh, 0.5, batch_size=8)
+    assert plan.shared and plan.n_actors == plan.n_learners == 1
+
+
+def test_shared_plan_spans_full_mesh():
+    mesh = make_mesh()
+    plan = shared_plan(mesh)
+    assert plan.shared
+    assert plan.n_actors == plan.n_learners == mesh.devices.size
+
+
+def test_shrink_actors_reclamps_and_exhausts():
+    mesh = make_mesh()
+    plan = plan_submesh(mesh, 0.5, batch_size=8)
+    learners = plan.learner_devices
+    while plan is not None and plan.n_actors > 1:
+        smaller = shrink_actors(plan, 0, batch_size=8)
+        assert smaller is not None
+        assert smaller.n_actors < plan.n_actors
+        assert 8 % smaller.n_actors == 0
+        assert smaller.learner_devices == learners  # learner side untouched
+        plan = smaller
+    # the last actor cannot be shed: the caller falls back to sync
+    assert shrink_actors(plan, 0, batch_size=8) is None
+
+
+# ---- strict-mode bit-identity ----------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "pipelined",
+    [True, pytest.param(False, marks=pytest.mark.slow)],
+    ids=["pipelined", "sequential"],
+)
+@pytest.mark.slow
+def test_strict_matches_sync_no_mesh(model_setup, pipelined):
+    """strict=True replays the sync schedule (its 1-deep pipeline, or the
+    sequential loop under pipelined=False) bit-for-bit with mesh=None:
+    decoded tokens, per-step metrics, params, and opt_state all match."""
+    model, state, feats, masks = model_setup
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                   pipelined=pipelined)
+    batches = _batches(feats, masks, 3)
+
+    r_sync = TokenReward(7)
+    sync = SCSTTrainer(model, r_sync, cfg)
+    s_sync, m_sync = sync.train_epoch(
+        state, iter(batches), jax.random.key(9), pipelined=pipelined
+    )
+
+    r_async = TokenReward(7)
+    a = AsyncSCSTTrainer(model, r_async, cfg, strict=True)
+    s_async, m_async = a.train_epoch(state, iter(batches), jax.random.key(9))
+
+    assert len(m_sync) == len(m_async) == 3
+    for ms, ma in zip(m_sync, m_async):
+        assert float(ms["rl_loss"]) == float(ma["rl_loss"])
+        assert ms["reward_mean"] == ma["reward_mean"]
+    # the reward computer saw the exact same token rows in the same order
+    assert len(r_sync.calls) == len(r_async.calls)
+    for rs, ra in zip(r_sync.calls, r_async.calls):
+        np.testing.assert_array_equal(rs, ra)
+    _assert_tree_equal(s_sync.params, s_async.params)
+    _assert_tree_equal(s_sync.opt_state, s_async.opt_state)
+
+
+@pytest.mark.slow
+def test_strict_matches_sync_on_mesh(model_setup):
+    """Mesh twin of the strict pin: both roles run the FULL mesh so the
+    shard_map decode's axis_index rng folds match the sync loop's."""
+    model, state, feats, masks = model_setup
+    mesh = make_mesh()
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy")
+    state_m = replicate(mesh, state)
+    f_s, m_s = shard_batch(mesh, (feats, masks))
+    batches = [(f_s, m_s, VIDS, None)] * 3
+
+    r_sync = TokenReward(7)
+    sync = SCSTTrainer(model, r_sync, cfg, mesh=mesh)
+    s_sync, m_sync = sync.train_epoch(state_m, iter(batches), jax.random.key(9))
+
+    r_async = TokenReward(7)
+    a = AsyncSCSTTrainer(model, r_async, cfg, mesh=mesh, strict=True,
+                         batch_size=B)
+    assert a._plan.shared  # strict pins the full-mesh shared layout
+    s_async, m_async = a.train_epoch(state_m, iter(batches), jax.random.key(9))
+
+    for ms, ma in zip(m_sync, m_async):
+        assert float(ms["rl_loss"]) == float(ma["rl_loss"])
+    for rs, ra in zip(r_sync.calls, r_async.calls):
+        np.testing.assert_array_equal(rs, ra)
+    _assert_tree_equal(s_sync.params, s_async.params)
+    _assert_tree_equal(s_sync.opt_state, s_async.opt_state)
+
+
+@pytest.mark.slow
+def test_depth1_bound0_is_implicitly_strict(model_setup):
+    """rollout_depth=1 + staleness_bound=0 IS the sequential sync schedule:
+    no strict flag needed (the config-driven strict mode)."""
+    model, state, feats, masks = model_setup
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                   pipelined=False, rollout_depth=1, staleness_bound=0)
+    batches = _batches(feats, masks, 2)
+
+    sync = SCSTTrainer(model, TokenReward(7), cfg)
+    s_sync, _ = sync.train_epoch(
+        state, iter(batches), jax.random.key(3), pipelined=False
+    )
+    a = AsyncSCSTTrainer(model, TokenReward(7), cfg)
+    assert a._strict
+    s_async, _ = a.train_epoch(state, iter(batches), jax.random.key(3))
+    _assert_tree_equal(s_sync.params, s_async.params)
+
+
+# ---- the genuinely decoupled schedule ---------------------------------------
+
+
+@pytest.mark.slow
+def test_decoupled_runs_and_reports_occupancy(model_setup):
+    model, state, feats, masks = model_setup
+    mesh = make_mesh()
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                   rollout_depth=2, staleness_bound=1)
+    state_m = replicate(mesh, state)
+    f_s, m_s = shard_batch(mesh, (feats, masks))
+    batches = [(f_s, m_s, VIDS, None)] * 6
+
+    a = AsyncSCSTTrainer(model, TokenReward(7), cfg, mesh=mesh, batch_size=B)
+    assert not a._plan.shared
+    s, metrics = a.train_epoch(state_m, iter(batches), jax.random.key(9))
+    assert len(metrics) == 6  # every batch got exactly one applied update
+    # defaults depth=2/bound=1: steady-state staleness 1, nothing dropped
+    assert a.last_dropped == 0
+    assert set(a.last_staleness) <= {0, 1}
+    assert 0.0 < a.last_occupancy["actor"] <= 1.0
+    assert 0.0 < a.last_occupancy["learner"] <= 1.0
+    # the returned state is back on the caller's full-mesh layout
+    dev_ids = {
+        d.id for leaf in jax.tree_util.tree_leaves(s.params)
+        for d in leaf.sharding.device_set
+    }
+    assert dev_ids == {d.id for d in mesh.devices.reshape(-1)}
+
+
+@pytest.mark.slow
+def test_staleness_drops_are_deterministic(model_setup):
+    """depth 3 / bound 1: steady-state staleness 2 exceeds the bound, so
+    batches are dropped and recounted — identically across two runs
+    (the recount re-decodes under refreshed params with the entry's own
+    stored rng key)."""
+    model, state, feats, masks = model_setup
+    mesh = make_mesh()
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                   rollout_depth=3, staleness_bound=1)
+    state_m = replicate(mesh, state)
+    f_s, m_s = shard_batch(mesh, (feats, masks))
+    batches = [(f_s, m_s, VIDS, None)] * 6
+
+    runs = []
+    for _ in range(2):
+        a = AsyncSCSTTrainer(model, TokenReward(7), cfg, mesh=mesh,
+                             batch_size=B)
+        s, m = a.train_epoch(state_m, iter(batches), jax.random.key(9))
+        runs.append((
+            a.last_dropped,
+            dict(a.last_staleness),
+            [float(x["rl_loss"]) for x in m],
+            s.params,
+        ))
+    assert runs[0][0] > 0  # the bound genuinely fired
+    assert runs[0][0] == runs[1][0]
+    assert runs[0][1] == runs[1][1]
+    assert runs[0][2] == runs[1][2]
+    _assert_tree_equal(runs[0][3], runs[1][3])
+    # recounted batches land at staleness 0 <= bound: nothing over the bound
+    assert all(k <= 1 for k in runs[0][1])
+
+
+@pytest.mark.slow
+def test_drain_persists_ring_and_resume_replays(model_setup):
+    """should_stop mid-epoch persists the in-flight ring into seam_sink;
+    a resumed epoch replays those exact tokens (replay-consistent: the
+    reward computer sees the SAME rows the pre-drain decode produced)."""
+    model, state, feats, masks = model_setup
+    mesh = make_mesh()
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                   rollout_depth=2, staleness_bound=1)
+    state_m = replicate(mesh, state)
+    f_s, m_s = shard_batch(mesh, (feats, masks))
+    batches = [(f_s, m_s, VIDS, None)] * 6
+
+    calls = {"n": 0}
+
+    def stop_after_4():
+        calls["n"] += 1
+        return calls["n"] > 4
+
+    sink: dict = {}
+    a = AsyncSCSTTrainer(model, TokenReward(7), cfg, mesh=mesh, batch_size=B)
+    s_half, m_half = a.train_epoch(
+        state_m, iter(batches), jax.random.key(9),
+        should_stop=stop_after_4, seam_sink=sink,
+    )
+    assert sink.get("ring"), "expected in-flight entries in the seam sink"
+    ring_tokens = [e["samples"].copy() for e in sink["ring"]]
+
+    # resume: skip the consumed batches, advance the rng chain past every
+    # batch the first run decoded (consumed + in-flight), replay the seam
+    done = len(m_half) + len(sink["ring"])
+    rest = batches[len(m_half):]
+    rng = jax.random.key(9)
+    for _ in range(done):
+        rng = jax.random.split(rng)[0]
+    r2 = TokenReward(7)
+    a2 = AsyncSCSTTrainer(model, r2, cfg, mesh=mesh, batch_size=B)
+    s_res, m_res = a2.train_epoch(s_half, iter(rest), rng, seam=sink)
+    assert len(m_half) + len(m_res) == 6
+    # the first consumed rows of the resumed run are the persisted tokens
+    # (reward sees the K*B sample rows first, then the greedy rows: the
+    # replayed batches' sample calls sit at stride 2)
+    for i, tok in enumerate(ring_tokens):
+        np.testing.assert_array_equal(
+            tok.reshape(-1, tok.shape[-1]), r2.calls[2 * i]
+        )
+
+
+@pytest.mark.slow
+def test_seam_ring_discarded_on_changed_batch_order(model_setup):
+    """A replay whose video ids don't match the incoming batch is discarded
+    (never marry old tokens to new features) and decoding goes live."""
+    model, state, feats, masks = model_setup
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                   rollout_depth=2, staleness_bound=1)
+    events = []
+    a = AsyncSCSTTrainer(
+        model, TokenReward(7), cfg,
+        on_event=lambda e, **kw: events.append(e),
+    )
+    stale_seam = {"ring": [{
+        "samples": np.zeros((2, B, T), np.int32),
+        "lps": np.zeros((2, B, T), np.float32),
+        "video_ids": ["other%d" % i for i in range(B)],
+        "valid": np.ones((B,), np.float32),
+        "rng": np.asarray(jax.random.key_data(jax.random.key(0))),
+        "batch_index": 0,
+    }]}
+    s, m = a.train_epoch(
+        state, iter(_batches(feats, masks, 2)), jax.random.key(9),
+        seam=stale_seam,
+    )
+    assert len(m) == 2
+    assert "seam_ring_discarded" in events
+
+
+# ---- chaos: actor preemption ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_actor_preempt_degrades_to_survivors(model_setup):
+    from cst_captioning_tpu.resilience.chaos import Fault, FaultPlan
+
+    model, state, feats, masks = model_setup
+    mesh = make_mesh()
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                   rollout_depth=2, staleness_bound=1)
+    state_m = replicate(mesh, state)
+    f_s, m_s = shard_batch(mesh, (feats, masks))
+    batches = [(f_s, m_s, VIDS, None)] * 6
+
+    events = []
+    a = AsyncSCSTTrainer(model, TokenReward(7), cfg, mesh=mesh, batch_size=B,
+                         on_event=lambda e, **kw: events.append((e, kw)))
+    n_actors = a._plan.n_actors
+    plan = FaultPlan([Fault("rl.actor.step", "actor_preempt", at=2)], seed=0)
+    with plan.activate():
+        s, m = a.train_epoch(state_m, iter(batches), jax.random.key(9))
+    assert len(m) == 6  # every batch still got exactly one update
+    assert plan.fired and plan.fired[0]["kind"] == "actor_preempt"
+    degraded = [kw for e, kw in events if e == "rl_actor_degraded"]
+    assert degraded and degraded[0]["survivors"] < n_actors
+    assert not a._fallback_sync
+
+
+@pytest.mark.slow
+def test_actor_preempt_exhaustion_falls_back_to_sync(model_setup):
+    from cst_captioning_tpu.resilience.chaos import Fault, FaultPlan
+
+    model, state, feats, masks = model_setup
+    mesh = make_mesh()
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                   rollout_depth=2, staleness_bound=1)
+    state_m = replicate(mesh, state)
+    f_s, m_s = shard_batch(mesh, (feats, masks))
+    batches = [(f_s, m_s, VIDS, None)] * 6
+
+    events = []
+    a = AsyncSCSTTrainer(model, TokenReward(7), cfg, mesh=mesh, batch_size=B,
+                         on_event=lambda e, **kw: events.append((e, kw)))
+    plan = FaultPlan(
+        [Fault("rl.actor.step", "actor_preempt", at=1, times=8)], seed=0
+    )
+    with plan.activate():
+        s, m = a.train_epoch(state_m, iter(batches), jax.random.key(9))
+    assert len(m) == 6
+    assert a._fallback_sync
+    assert any(e == "rl_actor_fallback_sync" for e, _ in events)
+    # metrics stay finite through the degradation chain
+    assert all(np.isfinite(float(x["rl_loss"])) for x in m)
+
+
+# ---- trainer seam serialization --------------------------------------------
+
+
+def test_seam_ring_npz_roundtrip(tmp_path):
+    """Trainer._seam_bytes/_load_seam carry the ring format losslessly."""
+    import types
+
+    from cst_captioning_tpu.train.trainer import Trainer
+
+    rng = np.random.default_rng(1)
+    ring = [
+        {
+            "samples": rng.integers(0, V, size=(2, B, T)).astype(np.int32),
+            "lps": rng.normal(size=(2, B, T)).astype(np.float32),
+            "video_ids": [f"v{i}" for i in range(B)],
+            "valid": np.ones((B,), np.float32),
+            "rng": np.asarray(
+                jax.random.key_data(jax.random.key(7)), np.uint32
+            ),
+            "batch_index": 3 + k,
+            "greedy": rng.integers(0, V, size=(B, T)).astype(np.int32),
+        }
+        for k in range(2)
+    ]
+    blob = Trainer._seam_bytes({"ring": ring}, epoch=2, batch_index=3)
+    ckpt = tmp_path / "step_000123"
+    ckpt.mkdir()
+    (ckpt / "seam.npz").write_bytes(blob)
+
+    logged = []
+    fake = types.SimpleNamespace(
+        log=types.SimpleNamespace(log=lambda ev, **kw: logged.append(ev))
+    )
+    seam = Trainer._load_seam(
+        fake, str(tmp_path),
+        {"ckpt_name": "step_000123", "phase": "rl", "batch_index": 3},
+    )
+    assert seam is not None and "seam_loaded" in logged
+    assert seam["epoch"] == 2 and seam["batch_index"] == 3
+    assert len(seam["ring"]) == 2
+    for orig, back in zip(ring, seam["ring"]):
+        np.testing.assert_array_equal(orig["samples"], back["samples"])
+        np.testing.assert_array_equal(orig["lps"], back["lps"])
+        np.testing.assert_array_equal(orig["greedy"], back["greedy"])
+        np.testing.assert_array_equal(orig["rng"], back["rng"])
+        assert orig["video_ids"] == back["video_ids"]
+        assert orig["batch_index"] == back["batch_index"]

@@ -525,3 +525,117 @@ def test_enospc_during_training_rotation_recovers(datasets, tmp_path_factory):
     (ev,) = events_of(d + "/ev.jsonl", "ckpt_enospc")
     assert ev["freed"] == ["step_00000002"]
     assert [s for s, _ in tr.ckpt.step_checkpoints()] == [4, 6]
+
+
+# ---- decoupled actor/learner topology ---------------------------------------
+
+
+@pytest.mark.slow
+def test_decoupled_preempt_ring_seam_resume_is_bit_identical(
+        datasets, tmp_path_factory):
+    """Decoupled-topology twin of the pipelined seam test: preempting the
+    actor/learner loop mid-epoch persists the in-flight rollout RING next
+    to the checkpoint; the resume replays those exact tokens. With shared
+    roles (use_mesh=False) the default depth-2/bound-1 ring IS the sync
+    1-deep pipeline, so the whole chain — straight decoupled, preempted +
+    resumed decoupled, straight pipelined sync — lands on bit-identical
+    params."""
+    train_ds, _ = datasets
+    d0 = str(tmp_path_factory.mktemp("decsync"))
+    d1 = str(tmp_path_factory.mktemp("decstraight"))
+    d2 = str(tmp_path_factory.mktemp("decpreempt"))
+
+    def run(ckpt_dir, resume="", topology="decoupled"):
+        cfg = make_cfg(ckpt_dir, len(train_ds.vocab), pipelined=True,
+                       batch_size=2, seq_per_vid=1, epochs=1, resume=resume,
+                       rl_topology=topology)
+        tr = Trainer(cfg, train_ds, None, log_path=ckpt_dir + "/ev.jsonl",
+                     use_mesh=False)
+        tr.train_xe()
+        tr.train_rl()
+        return tr
+
+    tr_sync = run(d0, topology="sync")
+    tr_straight = run(d1)
+    # shared roles + depth 2 + bound 1 replays the sync pipelined schedule
+    params_equal(tr_sync.state.params, tr_straight.state.params)
+
+    # 5 rl.step visits per epoch; visit 6 = the second update of epoch 2
+    # -> the stop lands with a decoded-but-unscored ring entry in flight
+    with FaultPlan([Fault("rl.step", "preempt", at=6)]).activate():
+        with pytest.raises(Preempted):
+            run(d2)
+    saves = events_of(d2 + "/ev.jsonl", "ckpt_step")
+    assert saves and saves[-1]["phase"] == "rl"
+    assert 0 < saves[-1]["batch_index"] < 5
+    assert saves[-1]["seam"] is True
+    step_dirs = [n for n in os.listdir(d2) if n.startswith("step_")]
+    assert any(
+        os.path.exists(os.path.join(d2, s, "seam.npz")) for s in step_dirs
+    )
+
+    tr_res = run(d2, resume="auto")
+    assert events_of(d2 + "/ev.jsonl", "seam_loaded")
+    assert tr_res.rl_epochs == tr_straight.rl_epochs == 2
+    assert int(tr_res.state.step) == int(tr_straight.state.step)
+    params_equal(tr_straight.state.params, tr_res.state.params)
+
+
+@pytest.mark.slow
+def test_decoupled_actor_preempt_degrades_to_survivors(datasets,
+                                                       tmp_path_factory):
+    """Seeded actor_preempt recovery: losing one actor device mid-epoch
+    sheds it, survivors keep decoding, the orphaned in-flight rollouts are
+    recounted, and every epoch completes with finite dynamics."""
+    train_ds, _ = datasets
+    d = str(tmp_path_factory.mktemp("actorshed"))
+    # 4 devices -> 2 actors / 2 learners; one preempt leaves 1 survivor
+    cfg = make_cfg(d, len(train_ds.vocab), num_devices=4,
+                   rl_topology="decoupled")
+    tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl")
+    try:
+        tr.train_xe()
+        with FaultPlan(
+            [Fault("rl.actor.step", "actor_preempt", at=1)]
+        ).activate():
+            tr.train_rl()
+        assert tr.rl_epochs == 2
+        (deg,) = events_of(d + "/ev.jsonl", "rl_actor_degraded")
+        assert deg["survivors"] == 1
+        assert not events_of(d + "/ev.jsonl", "rl_actor_fallback_sync")
+        rewards = [
+            e["reward"] for e in events_of(d + "/ev.jsonl", "rl_step")
+        ]
+        assert rewards and np.isfinite(rewards).all()
+        for leaf in jax.tree_util.tree_leaves(tr.state.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+    finally:
+        tr.close()
+
+
+@pytest.mark.slow
+def test_decoupled_zero_actor_falls_back_to_sync(datasets, tmp_path_factory):
+    """When the last actor is preempted the decoupled loop degrades all the
+    way to the sync schedule on the learner submesh and training still
+    completes — no crash, no lost batches."""
+    train_ds, _ = datasets
+    d = str(tmp_path_factory.mktemp("actorzero"))
+    # 2 devices -> 1 actor / 1 learner; the single preempt exhausts actors
+    cfg = make_cfg(d, len(train_ds.vocab), num_devices=2,
+                   rl_topology="decoupled")
+    tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl")
+    try:
+        tr.train_xe()
+        with FaultPlan(
+            [Fault("rl.actor.step", "actor_preempt", at=1)]
+        ).activate():
+            tr.train_rl()
+        assert tr.rl_epochs == 2
+        assert events_of(d + "/ev.jsonl", "rl_actor_fallback_sync")
+        # 2 RL batches/epoch x 2 epochs: every batch still produced a step
+        steps = {e["step"] for e in events_of(d + "/ev.jsonl", "rl_step")}
+        assert len(steps) == 4
+        for leaf in jax.tree_util.tree_leaves(tr.state.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+    finally:
+        tr.close()
