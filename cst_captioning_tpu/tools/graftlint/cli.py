@@ -4,7 +4,7 @@
         [--baseline PATH | --no-baseline] [--write-baseline]
         [--rules GL001,GL002] [--root DIR] [--list-rules]
         [--check-stale] [--timings] [--budget SECONDS] [--no-cache]
-        [--fix [--dry-run]] [--fix-check]
+        [--fix [--dry-run]] [--fix-check] [--changed-only]
 
 Exit codes: 0 = no new error/warning findings (info and baselined findings
 never gate), 1 = new findings / stale baseline or suppressions with
@@ -24,6 +24,13 @@ so applying ``--fix`` twice is always a no-op. ``--fix --dry-run`` prints
 the unified diff without writing. ``--fix-check`` is the CI spelling: it
 fails while any autofixable finding is unfixed, touching nothing.
 
+``--changed-only`` is the pre-commit fast path: pass 1 still indexes the
+whole tree (so cross-module rules keep their whole-program knowledge and
+the warm cache makes it cheap), but pass 2 runs only on files git reports
+as changed vs HEAD (plus untracked). It is exclusive with the
+authoritative gates (``--fix``/``--fix-check``/``--write-baseline``/
+``--check-stale``), which need full-tree findings.
+
 The runtime counterpart of the static GL001/GL013 transfer claims is
 ``scripts/sanitize.sh``, which runs a tier-1 subset under
 ``pytest --sanitize`` (``jax.transfer_guard("disallow")`` + debug_nans).
@@ -34,6 +41,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from cst_captioning_tpu.tools.graftlint.core import (
@@ -45,6 +53,38 @@ from cst_captioning_tpu.tools.graftlint.core import (
 )
 
 _DEFAULT_PATHS = ("cst_captioning_tpu", "tests", "scripts")
+
+
+def _git_changed_files(root: str) -> list[str] | None:
+    """Absolute paths of .py files changed vs HEAD (tracked diffs plus
+    untracked files, .gitignore respected). ``None`` when ``root`` is not
+    a git checkout — the caller turns that into a usage error rather than
+    silently linting nothing."""
+    rels: list[str] = []
+    for cmd in (
+        ["git", "-C", root, "diff", "--name-only", "HEAD", "--"],
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=10,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if out.returncode != 0:
+            return None
+        rels += out.stdout.splitlines()
+    seen: set[str] = set()
+    files: list[str] = []
+    for rel in rels:
+        rel = rel.strip()
+        if not rel.endswith(".py") or rel in seen:
+            continue
+        seen.add(rel)
+        path = os.path.join(root, rel)
+        if os.path.isfile(path):  # deletions show in the diff too
+            files.append(path)
+    return files
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -97,6 +137,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--fix-check", action="store_true",
                     help="CI mode: fail (exit 1) while any autofixable "
                          "finding is unfixed; never writes")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="fast pre-commit path: build the full whole-program "
+                         "index as usual, but run pass 2 only on files git "
+                         "reports as changed (diff vs HEAD + untracked); "
+                         "exclusive with --fix/--fix-check/--write-baseline/"
+                         "--check-stale, which need full-tree findings")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -138,10 +184,30 @@ def main(argv: list[str] | None = None) -> int:
         print("graftlint: --dry-run only means something with --fix",
               file=sys.stderr)
         return 2
+    only_files = None
+    if args.changed_only:
+        for flag, on in (("--fix", args.fix), ("--fix-check", args.fix_check),
+                         ("--write-baseline", args.write_baseline),
+                         ("--check-stale", args.check_stale)):
+            if on:
+                print(f"graftlint: --changed-only and {flag} are exclusive "
+                      "— the authoritative gates need full-tree findings",
+                      file=sys.stderr)
+                return 2
+        only_files = _git_changed_files(root)
+        if only_files is None:
+            print("graftlint: --changed-only needs a git checkout at "
+                  f"{root}", file=sys.stderr)
+            return 2
+        if not only_files:
+            print("graftlint: --changed-only: no changed .py files, "
+                  "nothing to lint", file=sys.stderr)
+            return 0
     try:
         result = lint_paths(
             paths, root, baseline=baseline, rule_ids=rule_ids,
             cache_path="" if args.no_cache else None,
+            only_files=only_files,
         )
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)

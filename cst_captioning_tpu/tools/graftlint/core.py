@@ -503,13 +503,19 @@ def lint_paths(
     rule_ids: Iterable[str] | None = None,
     on_file: Callable[[str], None] | None = None,
     cache_path: str | None = None,
+    only_files: Iterable[str] | None = None,
 ) -> LintResult:
     """Two-pass driver. Pass 1 builds the whole-program
     :class:`~.project.ProjectIndex` over every file (reusing the mtime-keyed
     on-disk summary cache — ``cache_path=''`` disables it); pass 2 runs the
     per-file rules unchanged plus the :class:`ProjectRule`s against the
     index. Suppression usage and baseline hit-counts are tracked so
-    ``--check-stale`` can report dead grandfathers and dead disables."""
+    ``--check-stale`` can report dead grandfathers and dead disables.
+
+    ``only_files`` (absolute paths) limits PASS 2 to a subset of the
+    files — the index still covers everything, so cross-module rules keep
+    their whole-program knowledge. This is ``--changed-only``'s fast
+    path: warm index + a handful of changed files."""
     from cst_captioning_tpu.tools.graftlint.project import ProjectIndex
 
     rules = all_rules()
@@ -524,12 +530,17 @@ def lint_paths(
     index = ProjectIndex.build(files, root, cache_path=cache_path)
     index_seconds = time.perf_counter() - t0
 
+    pass2_files = files
+    if only_files is not None:
+        wanted = {os.path.abspath(p) for p in only_files}
+        pass2_files = [p for p in files if os.path.abspath(p) in wanted]
+
     findings: list[Finding] = []
     # (relpath, line) -> rule ids whose suppression actually fired there
     used_supp: dict[tuple[str, int], set[str]] = {}
     all_supp: list[tuple[str, int, set[str]]] = []
     t0 = time.perf_counter()
-    for path in files:
+    for path in pass2_files:
         if on_file is not None:
             on_file(path)
         relpath = os.path.relpath(path, root).replace(os.sep, "/")
@@ -572,7 +583,7 @@ def lint_paths(
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     result = LintResult(
         findings=findings,
-        files_checked=len(files),
+        files_checked=len(pass2_files),
         index_seconds=index_seconds,
         rules_seconds=rules_seconds,
         index_stats=dataclasses.asdict(index.stats),

@@ -39,6 +39,20 @@ What the index knows:
   which of its own params it forwards into a donated position of a
   donating callee (a *wrapper* whose donation an outer ``jit`` would
   silently drop) — all propagated through the same fixpoint for GL017.
+- **shape-sharding environment** — per-function abstract shape/dtype/
+  sharding facts: array dims recorded at literal constructors
+  (``jnp.zeros((4, 128))``) and ``.shape``-unpacking sites, dtype
+  provenance through ``astype``/``dtype=`` bindings (the bf16-on-the-wire
+  casts in ``parallel/comms.py``), PartitionSpec literal bindings, and a
+  per-host taint: whether a value (and hence any shape or wire dtype
+  derived from it) depends on this process's identity
+  (``jax.process_index()``, ``len(jax.local_devices())``,
+  ``process_index``-conditional branches). Results of
+  ``process_allgather``-style collectives are globally consistent and
+  CLEANSE the taint. Whether a function's *return* has a host-dependent
+  shape propagates through the same cross-module fixpoint; GL019 reads
+  the result at every collective site reachable from ``train/multihost.py``
+  or the comms bucket path (``index.multihost_reach``).
 - **on-disk summary cache** — ``<root>/.graftlint_cache.json`` keyed by
   ``(mtime, size)`` per file, so repeat ``lint.sh`` runs skip re-parsing
   unchanged modules in pass 1. Summaries are cached PRE-fixpoint; the
@@ -123,6 +137,36 @@ COLLECTIVE_AXIS_KWARGS = ("axis_name",)
 
 # call-position names that bind named axes for the function they wrap
 _AXIS_BINDERS = {"shard_map", "vmap", "pmap"}
+
+# resolved dotted calls whose RESULT differs per host (per-process): the
+# seeds of the GL019 host-taint. jax.devices()/jax.process_count() are
+# deliberately absent — they are globally consistent.
+PER_HOST_CALLS = {
+    "jax.process_index",
+    "jax.local_device_count",
+    "jax.local_devices",
+    "jax.addressable_devices",
+}
+# resolved dotted calls whose result is GLOBALLY CONSISTENT even when fed
+# per-host values: the collective itself synchronizes, so its result (and
+# anything derived from it, e.g. a gathered-lengths ``.max()``) is safe to
+# size buffers with. These cleanse the host taint.
+GLOBALLY_CONSISTENT_CALLS = {
+    "jax.experimental.multihost_utils.process_allgather",
+    "jax.experimental.multihost_utils.broadcast_one_to_all",
+    "multihost_utils.process_allgather",
+    "multihost_utils.broadcast_one_to_all",
+}
+# array constructors whose FIRST argument (or ``shape=``) is the shape —
+# a host-tainted dim expression here makes the array's shape per-host
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty"}
+
+# PartitionSpec constructor names (resolved) for the pspec-binding scrape
+_PSPEC_TYPES = {
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.pjit.PartitionSpec",
+    "jax.interpreters.pxla.PartitionSpec",
+}
 
 _DONATE_KWARGS = ("donate_argnums", "donate_argnames")
 
@@ -316,6 +360,32 @@ class FunctionSummary:
     # the human chain for each position lives in forwards_donated_via
     forwards_donated: list[int] = field(default_factory=list)
     forwards_donated_via: dict[str, str] = field(default_factory=dict)
+    # -- shape-sharding environment (GL019 substrate, cache schema v5) --
+    # local var -> abstract dims recorded at a literal constructor binding
+    # (ints, ".shape"-derived tokens like "memory.shape[0]", or "?" for a
+    # dim the walker cannot resolve)
+    array_dims: dict[str, list] = field(default_factory=dict)
+    # local var -> the ".shape" source it unpacks ("B, M, E = memory.shape"
+    # records B -> "memory.shape[0]", ...)
+    dim_vars: dict[str, str] = field(default_factory=dict)
+    # local var -> dtype name bound via astype(...)/dtype= (dtype
+    # provenance: the comms bf16-on-the-wire cast records "bfloat16")
+    dtype_env: dict[str, str] = field(default_factory=dict)
+    # local var -> PartitionSpec literal axes (None entries for replicated
+    # dims), from ``spec = P('data', None)``-style bindings
+    pspec_vars: dict[str, list] = field(default_factory=dict)
+    # abstract dims of the returned expression, when derivable
+    return_dims: list | None = None
+    return_dtype: str = ""
+    # the return value's SHAPE (or wire dtype) depends on per-host values —
+    # seeded intraprocedurally, propagated through returns_calls by the
+    # fixpoint (like returns_device)
+    returns_host_shape: bool = False
+    host_shape_reason: str = ""
+    # the return VALUE differs per host (e.g. host_shard() returning
+    # process_index) — callers sizing buffers with it inherit the taint
+    returns_host_value: bool = False
+    host_value_reason: str = ""
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -426,11 +496,335 @@ class ModuleSummary:
         return out
 
 
+# repo-local wrapper names whose results are also globally consistent (they
+# sit directly on process_allgather); matched by last segment because the
+# lazy in-function imports defeat full dotted resolution at some call sites
+GLOBALLY_CONSISTENT_LASTS = {
+    "process_allgather", "broadcast_one_to_all", "allgather_pyobj",
+    "broadcast_pyobj", "allgather_to_host", "global_scalar_mean",
+    "global_weighted_mean",
+}
+
+class HostTaint:
+    """Abstract shape/dtype/per-host-taint environment over ONE function
+    body, in source order.
+
+    Tracks, per local name: whether its VALUE differs per host (seeded by
+    :data:`PER_HOST_CALLS`, spread by containment, cleansed by
+    :data:`GLOBALLY_CONSISTENT_CALLS`), whether its SHAPE or wire dtype
+    does (constructor dims / ``astype`` / ragged slice bounds built from
+    per-host values), abstract array dims at literal constructors,
+    ``.shape``-unpack dim sources, dtype provenance, and PartitionSpec
+    literal bindings.
+
+    Used twice: the pass-1 summarizer runs it WITHOUT cross-module
+    resolution (``lookup=None``) to seed the cached per-function facts;
+    GL019 re-runs it at rule time with ``lookup`` wired to the project
+    index, so calls to functions whose summaries say
+    ``returns_host_value``/``returns_host_shape`` taint their results."""
+
+    def __init__(self, aliases: dict[str, str], lookup=None,
+                 may_host: bool = True):
+        self.aliases = aliases
+        self.lookup = lookup       # dotted -> FunctionSummary | None
+        # cheap pass-1 gate: a module that never names a per-host API (and
+        # has no index to resolve callees through) cannot seed host taint,
+        # so the per-bind taint walks can short-circuit
+        self.may_host = may_host or lookup is not None
+        self.host_vals: dict[str, str] = {}
+        self.host_shapes: dict[str, str] = {}
+        self.var_dims: dict[str, list] = {}
+        self.dim_vars: dict[str, str] = {}
+        self.dtype_env: dict[str, str] = {}
+        self.pspec_vars: dict[str, list] = {}
+
+    # -- queries --------------------------------------------------------
+
+    def _callee(self, resolved: str):
+        if self.lookup is None or not resolved or \
+                resolved.startswith(("jax.", "numpy.")):
+            return None
+        return self.lookup(resolved)
+
+    def value_taint(self, expr: ast.AST | None) -> str:
+        """Why ``expr``'s VALUE differs per host ('' = no known reason)."""
+        if expr is None or not self.may_host:
+            return ""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+                continue  # separate scope
+            if isinstance(node, ast.Call):
+                resolved = resolve_dotted(_dotted(node.func), self.aliases)
+                if resolved in PER_HOST_CALLS:
+                    return f"{resolved}()"
+                if resolved in GLOBALLY_CONSISTENT_CALLS or \
+                        _last(resolved) in GLOBALLY_CONSISTENT_LASTS:
+                    continue  # synchronized result: args don't leak out
+                target = self._callee(resolved)
+                if target is not None and target.returns_host_value:
+                    return (f"{resolved}() → "
+                            f"{target.host_value_reason or 'per-host value'}")
+            if isinstance(node, ast.Name) and node.id in self.host_vals:
+                return self.host_vals[node.id]
+            stack.extend(ast.iter_child_nodes(node))
+        return ""
+
+    def shape_taint(self, expr: ast.AST | None) -> str:
+        """Why ``expr``'s SHAPE or wire dtype differs per host ('' = no
+        provable reason — unknown shapes stay quiet, never guess)."""
+        if expr is None or not self.may_host:
+            return ""
+        if isinstance(expr, ast.Name):
+            return self.host_shapes.get(expr.id, "")
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for e in expr.elts:
+                t = self.shape_taint(e)
+                if t:
+                    return t
+            return ""
+        if isinstance(expr, ast.BinOp):
+            return self.shape_taint(expr.left) or \
+                self.shape_taint(expr.right)
+        if isinstance(expr, ast.IfExp):
+            t = self.value_taint(expr.test)
+            if t:
+                return f"shape chosen by a branch on {t}"
+            return self.shape_taint(expr.body) or \
+                self.shape_taint(expr.orelse)
+        if isinstance(expr, ast.Subscript):
+            sl = expr.slice
+            bounds: list = []
+            if isinstance(sl, ast.Slice):
+                bounds = [b for b in (sl.lower, sl.upper, sl.step)
+                          if b is not None]
+            for b in bounds:
+                t = self.value_taint(b)
+                if t:
+                    return f"ragged slice bound from {t}"
+            return self.shape_taint(expr.value)
+        if isinstance(expr, ast.Call):
+            resolved = resolve_dotted(_dotted(expr.func), self.aliases)
+            last = _last(resolved)
+            if last in _SHAPE_CTORS:
+                shape_arg = expr.args[0] if expr.args else None
+                for kw in expr.keywords:
+                    if kw.arg == "shape":
+                        shape_arg = kw.value
+                t = self.value_taint(shape_arg)
+                if t:
+                    return f"constructor shape built from {t}"
+                return self._dtype_kwarg_taint(expr)
+            if last == "astype":
+                t = self.value_taint(expr.args[0]) if expr.args else ""
+                if t:
+                    return f"wire dtype chosen by {t}"
+                if isinstance(expr.func, ast.Attribute):
+                    return self.shape_taint(expr.func.value)
+                return ""
+            if last == "reshape":
+                for a in expr.args:
+                    t = self.value_taint(a)
+                    if t:
+                        return f"reshaped to a size from {t}"
+                if isinstance(expr.func, ast.Attribute):
+                    return self.shape_taint(expr.func.value)
+                return ""
+            if last in ("concatenate", "stack", "vstack", "hstack",
+                        "asarray", "array"):
+                for a in expr.args:
+                    t = self.shape_taint(a)
+                    if t:
+                        return t
+                return self._dtype_kwarg_taint(expr)
+            if last == "pad":
+                for a in expr.args[1:]:
+                    t = self.value_taint(a)
+                    if t:
+                        return f"pad widths from {t}"
+                return self.shape_taint(expr.args[0]) if expr.args else ""
+            target = self._callee(resolved)
+            if target is not None and target.returns_host_shape:
+                return (f"{resolved}() returns a per-host shape "
+                        f"({target.host_shape_reason or 'see its body'})")
+            return ""
+        return ""
+
+    def _dtype_kwarg_taint(self, call: ast.Call) -> str:
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                t = self.value_taint(kw.value)
+                if t:
+                    return f"dtype chosen by {t}"
+        return ""
+
+    def dims_of(self, expr: ast.AST | None) -> list | None:
+        """Abstract dims of ``expr``: ints for literal constructor dims,
+        ``.shape``-derived tokens for named dims, '?' for unresolved,
+        'host:<why>' for per-host dims. None = not an array the walker
+        can size."""
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Name):
+            return self.var_dims.get(expr.id)
+        if isinstance(expr, ast.Call):
+            resolved = resolve_dotted(_dotted(expr.func), self.aliases)
+            last = _last(resolved)
+            if last in _SHAPE_CTORS or last == "reshape":
+                if last == "reshape":
+                    shape_elts = list(expr.args)
+                else:
+                    shape_arg = expr.args[0] if expr.args else None
+                    for kw in expr.keywords:
+                        if kw.arg == "shape":
+                            shape_arg = kw.value
+                    if shape_arg is None:
+                        return None
+                    if isinstance(shape_arg, (ast.Tuple, ast.List)):
+                        shape_elts = list(shape_arg.elts)
+                    else:
+                        shape_elts = [shape_arg]
+                out: list = []
+                for e in shape_elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, int
+                    ):
+                        out.append(e.value)
+                    elif isinstance(e, ast.Name) and e.id in self.dim_vars:
+                        out.append(self.dim_vars[e.id])
+                    else:
+                        t = self.value_taint(e)
+                        out.append(f"host:{t}" if t else "?")
+                return out
+            if last in ("astype", "asarray", "array") and expr.args:
+                base = expr.args[0] if last != "astype" else (
+                    expr.func.value
+                    if isinstance(expr.func, ast.Attribute) else None
+                )
+                return self.dims_of(base)
+            target = self._callee(resolved)
+            if target is not None and target.return_dims is not None:
+                return list(target.return_dims)
+            return None
+        return None
+
+    def dtype_of(self, expr: ast.AST | None) -> str:
+        """Dtype name bound by ``expr`` ('' = unknown): ``x.astype(d)``,
+        a ``dtype=`` constructor kwarg, or a callee's return dtype."""
+        if expr is None:
+            return ""
+        if isinstance(expr, ast.Name):
+            return self.dtype_env.get(expr.id, "")
+        if not isinstance(expr, ast.Call):
+            return ""
+        resolved = resolve_dotted(_dotted(expr.func), self.aliases)
+        last = _last(resolved)
+        if last == "astype" and expr.args:
+            return self._dtype_name(expr.args[0])
+        for kw in expr.keywords:
+            if kw.arg == "dtype":
+                return self._dtype_name(kw.value)
+        target = self._callee(resolved)
+        if target is not None and target.return_dtype:
+            return target.return_dtype
+        return ""
+
+    def _dtype_name(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        d = _last(_dotted(node))
+        return d if d else ""
+
+    def pspec_of(self, expr: ast.AST | None) -> list | None:
+        """PartitionSpec literal axes, or None when not a spec literal."""
+        if not isinstance(expr, ast.Call):
+            return None
+        resolved = resolve_dotted(_dotted(expr.func), self.aliases)
+        if resolved not in _PSPEC_TYPES:
+            return None
+        out: list = []
+        for a in expr.args:
+            if isinstance(a, ast.Constant):
+                out.append(a.value if isinstance(a.value, str) else None)
+            elif isinstance(a, (ast.Tuple, ast.List)):
+                out.append([
+                    e.value for e in a.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                ])
+            else:
+                out.append("?")
+        return out
+
+    # -- binding --------------------------------------------------------
+
+    def bind(self, names: list[str], value: ast.AST) -> None:
+        """Rebind ``names`` (pure Name/tuple targets only — mutations like
+        ``x[i] = v`` must not clear what is known about ``x``)."""
+        vt = self.value_taint(value)
+        st = self.shape_taint(value)
+        dims = self.dims_of(value)
+        dt = self.dtype_of(value)
+        pspec = self.pspec_of(value)
+        shape_src = ""
+        if isinstance(value, ast.Attribute) and value.attr == "shape":
+            shape_src = _dotted(value.value) or "<expr>"
+        for n in names:
+            self.host_vals.pop(n, None)
+            self.host_shapes.pop(n, None)
+            self.var_dims.pop(n, None)
+            self.dim_vars.pop(n, None)
+            self.dtype_env.pop(n, None)
+            self.pspec_vars.pop(n, None)
+            if vt:
+                self.host_vals[n] = vt
+            if st:
+                self.host_shapes[n] = st
+            if dims is not None:
+                self.var_dims[n] = dims
+            if dt:
+                self.dtype_env[n] = dt
+            if pspec is not None:
+                self.pspec_vars[n] = pspec
+        if shape_src and len(names) > 1:
+            # B, M, E = memory.shape — each name is a dim of the source
+            for i, n in enumerate(names):
+                self.dim_vars[n] = f"{shape_src}.shape[{i}]"
+        elif len(names) == 1 and isinstance(value, ast.Subscript) and \
+                isinstance(value.value, ast.Attribute) and \
+                value.value.attr == "shape" and \
+                isinstance(value.slice, ast.Constant) and \
+                isinstance(value.slice.value, int):
+            # n = x.shape[0]
+            src = _dotted(value.value.value) or "<expr>"
+            self.dim_vars[names[0]] = f"{src}.shape[{value.slice.value}]"
+
+    def taint_branch_stores(self, stmts: list[ast.stmt],
+                            reason: str) -> None:
+        """Names assigned under a per-host-conditional branch get BOTH
+        taints: their value and (potentially) their shape now depend on
+        which host is running."""
+        why = f"assigned under a branch on {reason}"
+        work: list[ast.AST] = list(stmts)
+        while work:
+            node = work.pop()
+            if isinstance(node, _FUNC_NODES + (ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                self.host_vals.setdefault(node.id, why)
+                self.host_shapes.setdefault(node.id, why)
+            work.extend(ast.iter_child_nodes(node))
+
+
 class _FunctionSummarizer:
     """Single in-order walk of one function body (nested defs excluded:
     they are separate scopes, summarized — when top-level — on their own)."""
 
-    def __init__(self, fn: ast.AST, qualname: str, aliases: dict[str, str]):
+    def __init__(self, fn: ast.AST, qualname: str, aliases: dict[str, str],
+                 may_host: bool = True):
         self.fn = fn
         self.aliases = aliases
         args = fn.args
@@ -457,6 +851,9 @@ class _FunctionSummarizer:
         self.donating_vars: dict[str, tuple[int, ...]] = {}
         self.has_device_put = False
         self.yields_any = False
+        # shape-sharding environment (GL019 substrate), local-only here:
+        # cross-module resolution happens in the fixpoint / at rule time
+        self.shapes = HostTaint(aliases, may_host=may_host)
 
     def run(self) -> FunctionSummary:
         for stmt in self.fn.body:
@@ -473,6 +870,13 @@ class _FunctionSummarizer:
                 self.summary.device_reason
                 or "generator stages values via jax.device_put"
             )
+        # export the shape-sharding environment (capped: the cache must
+        # stay small, and huge functions bound the fixpoint's working set)
+        env = self.shapes
+        self.summary.array_dims = dict(list(env.var_dims.items())[:32])
+        self.summary.dim_vars = dict(list(env.dim_vars.items())[:32])
+        self.summary.dtype_env = dict(list(env.dtype_env.items())[:32])
+        self.summary.pspec_vars = dict(list(env.pspec_vars.items())[:32])
         return self.summary
 
     # -- statement walk, in source order --------------------------------
@@ -497,6 +901,18 @@ class _FunctionSummarizer:
             for stmt in node.body + node.orelse:
                 self._stmt(stmt)
             return
+        elif isinstance(node, ast.If):
+            self._visit_expr(node.test)
+            for stmt in node.body + node.orelse:
+                self._stmt(stmt)
+            # anything assigned under a per-host conditional (e.g. an
+            # `if jax.process_index() == 0:` branch) is per-host itself
+            reason = self.shapes.value_taint(node.test)
+            if reason:
+                self.shapes.taint_branch_stores(
+                    node.body + node.orelse, reason
+                )
+            return
         else:
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, ast.expr):
@@ -511,6 +927,19 @@ class _FunctionSummarizer:
             for sub in ast.walk(t):
                 if isinstance(sub, ast.Name):
                     names.append(sub.id)
+        # shape env rebinds only on pure name targets: `x[i] = v` mutates
+        # x's contents, not its shape, and must not clear what is known
+        rebinds: list[str] = []
+        for t in targets:
+            if isinstance(t, ast.Name):
+                rebinds.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    inner = e.value if isinstance(e, ast.Starred) else e
+                    if isinstance(inner, ast.Name):
+                        rebinds.append(inner.id)
+        if rebinds:
+            self.shapes.bind(rebinds, value)
         prov, reason, pending = self._provenance(value)
         donated = donation_of_call(value) if isinstance(value, ast.Call) \
             else None
@@ -541,6 +970,23 @@ class _FunctionSummarizer:
             self.summary.returns_donating = sorted(
                 set(self.summary.returns_donating) | set(donated)
             )
+        # shape-sharding return facts (first reason wins)
+        if not self.summary.returns_host_shape:
+            st = self.shapes.shape_taint(expr)
+            if st:
+                self.summary.returns_host_shape = True
+                self.summary.host_shape_reason = st
+        if not self.summary.returns_host_value:
+            vt = self.shapes.value_taint(expr)
+            if vt:
+                self.summary.returns_host_value = True
+                self.summary.host_value_reason = vt
+        if self.summary.return_dims is None:
+            dims = self.shapes.dims_of(expr)
+            if dims is not None:
+                self.summary.return_dims = dims
+        if not self.summary.return_dtype:
+            self.summary.return_dtype = self.shapes.dtype_of(expr)
 
     # -- expression analysis --------------------------------------------
 
@@ -655,18 +1101,27 @@ class _FunctionSummarizer:
         return False, "", first_pending
 
 
-def summarize_module(tree: ast.Module, relpath: str) -> ModuleSummary:
-    """Pass-1 summary of one parsed module (pure function of the AST)."""
+# a module whose source never names one of these cannot seed per-host
+# taint locally — the summarizer's taint walks short-circuit there
+_PER_HOST_TOKENS = ("process_index", "local_device", "addressable_devices")
+
+
+def summarize_module(tree: ast.Module, relpath: str,
+                     source: str = "") -> ModuleSummary:
+    """Pass-1 summary of one parsed module (pure function of the AST;
+    ``source``, when given, only gates the host-taint walks cheaply)."""
     module = module_name_for(relpath)
     aliases = import_aliases(tree, module)
     out = ModuleSummary(module=module, relpath=relpath, aliases=aliases)
+    may_host = any(t in source for t in _PER_HOST_TOKENS) if source \
+        else True
 
     def visit(body: list[ast.stmt], prefix: str) -> None:
         for node in body:
             if isinstance(node, _FUNC_NODES):
                 qual = f"{prefix}{node.name}"
                 out.functions[qual] = _FunctionSummarizer(
-                    node, qual, aliases
+                    node, qual, aliases, may_host=may_host
                 ).run()
             elif isinstance(node, ast.ClassDef):
                 visit(node.body, f"{prefix}{node.name}.")
@@ -873,30 +1328,56 @@ class MeshDecl:
 
 
 MESH_RELPATH = "cst_captioning_tpu/train/mesh.py"
+SUBMESH_RELPATH = "cst_captioning_tpu/parallel/submesh.py"
+# GL019 seed modules: collectives reachable from these are cross-host
+# rendezvous points where every participating host must agree
+MULTIHOST_SEED_RELPATHS = (
+    "cst_captioning_tpu/train/multihost.py",
+    "cst_captioning_tpu/parallel/comms.py",
+)
+
+
+def _axis_param_defaults(tree: ast.Module) -> set[str]:
+    """Axis names declared as string defaults of ``*axis``-suffixed
+    function parameters (the ``axis="data"`` factory spelling)."""
+    axes: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, _FUNC_NODES):
+            continue
+        args = node.args
+        pos = args.posonlyargs + args.args
+        pairs = list(
+            zip(pos[len(pos) - len(args.defaults):], args.defaults)
+        ) + [
+            (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+            if d is not None
+        ]
+        for arg, default in pairs:
+            if arg.arg.endswith("axis") and isinstance(
+                default, ast.Constant
+            ) and isinstance(default.value, str) and default.value:
+                axes.add(default.value)
+    return axes
+
+
+def scrape_submesh_axes(tree: ast.Module) -> MeshDecl:
+    """SubmeshPlan axis declarations from ``parallel/submesh.py`` —
+    axes only, NO default fallback: an empty result merges into the
+    mesh decl as a no-op instead of widening it."""
+    return MeshDecl(
+        axes=frozenset(_axis_param_defaults(tree)), families=(),
+        contract="", found=True,
+    )
 
 
 def scrape_mesh_decl(tree: ast.Module) -> MeshDecl:
     """Mesh axes (string defaults of ``*axis`` function parameters),
     PARAM_PARTITION_RULES families, and the SHARDING_CONTRACT path."""
-    axes: set[str] = set()
+    axes: set[str] = set(_axis_param_defaults(tree))
     families: list[tuple[str, str]] = []
     contract = ""
     for node in ast.walk(tree):
-        if isinstance(node, _FUNC_NODES):
-            args = node.args
-            pos = args.posonlyargs + args.args
-            pairs = list(
-                zip(pos[len(pos) - len(args.defaults):], args.defaults)
-            ) + [
-                (a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
-                if d is not None
-            ]
-            for arg, default in pairs:
-                if arg.arg.endswith("axis") and isinstance(
-                    default, ast.Constant
-                ) and isinstance(default.value, str) and default.value:
-                    axes.add(default.value)
-        elif isinstance(node, ast.Assign):
+        if isinstance(node, ast.Assign):
             names = [
                 t.id for t in node.targets if isinstance(t, ast.Name)
             ]
@@ -926,9 +1407,13 @@ CACHE_NAME = ".graftlint_cache.json"
 # (donated_argnums/returns_donating/forwards_donated) joined the summaries.
 # v4: collective axes resolve through string-default ``*axis`` parameters
 # (the ``axis="data"`` factory spelling), not just call-site literals.
+# v5: shape-sharding environment joined the summaries (array_dims /
+# dim_vars / dtype_env / pspec_vars / return_dims / return_dtype /
+# returns_host_shape / returns_host_value), and parallel/submesh.py axis
+# declarations are scraped alongside train/mesh.py.
 # A version mismatch discards the cache wholesale — cold start, never a
 # half-read of the old schema.
-_CACHE_VERSION = 4
+_CACHE_VERSION = 5
 _FIXPOINT_MAX_ROUNDS = 25
 
 
@@ -961,6 +1446,9 @@ class ProjectIndex:
         self._axis_by_last: dict[str, list[str]] | None = None
         self.donation_names: frozenset = frozenset()
         self.key_consumer_names: frozenset = frozenset()
+        # defs reachable (via resolved call edges) from train/multihost.py
+        # or the comms bucket path — GL019's scope
+        self.multihost_reach: frozenset = frozenset()
         # (source, tree) for files parsed THIS run (cache misses): pass 2
         # adopts them instead of re-parsing
         self.parsed: dict[str, tuple[str, ast.Module]] = {}
@@ -980,13 +1468,17 @@ class ProjectIndex:
         entries = cache.get("files", {})
         dirty = False
 
-        mesh_path = os.path.join(index.root, MESH_RELPATH)
         todo = list(files)
-        if os.path.exists(mesh_path) and not any(
-            os.path.abspath(p) == mesh_path for p in todo
-        ):
-            todo.append(mesh_path)
+        # force mesh/submesh axis declarations into every index, however
+        # narrow the linted path set is
+        for decl_rel in (MESH_RELPATH, SUBMESH_RELPATH):
+            decl_path = os.path.join(index.root, decl_rel)
+            if os.path.exists(decl_path) and not any(
+                os.path.abspath(p) == decl_path for p in todo
+            ):
+                todo.append(decl_path)
 
+        submesh_axes: set[str] = set()
         for path in todo:
             relpath = os.path.relpath(path, index.root).replace(os.sep, "/")
             try:
@@ -1018,6 +1510,18 @@ class ProjectIndex:
             index.by_relpath[relpath] = summary
             if relpath == MESH_RELPATH and mesh is not None:
                 index.mesh = mesh
+            elif relpath == SUBMESH_RELPATH and mesh is not None:
+                submesh_axes |= set(mesh.axes)
+
+        if submesh_axes - set(index.mesh.axes):
+            # merge AFTER the file loop: iteration order must not decide
+            # whether submesh axes land before or after the mesh decl
+            index.mesh = MeshDecl(
+                axes=frozenset(set(index.mesh.axes) | submesh_axes),
+                families=index.mesh.families,
+                contract=index.mesh.contract,
+                found=index.mesh.found,
+            )
 
         for module in index.modules.values():
             for qual, fn in module.functions.items():
@@ -1176,6 +1680,36 @@ class ProjectIndex:
                             )
                             changed = True
                             break
+                # host-shape/value facts through returned callee results
+                # (GL019: `return host_shard()` is as per-host as the
+                # callee's own body)
+                for callee in fn.returns_calls:
+                    if fn.returns_host_shape and fn.returns_host_value:
+                        break
+                    hit = self.lookup_from(mod, callee)
+                    target = hit[1] if hit else None
+                    if target is None:
+                        continue
+                    if target.returns_host_shape and \
+                            not fn.returns_host_shape:
+                        fn.returns_host_shape = True
+                        fn.host_shape_reason = (
+                            f"returns {callee}(...) → "
+                            f"{target.host_shape_reason or 'per-host shape'}"
+                        )
+                        changed = True
+                    if target.returns_host_value and \
+                            not fn.returns_host_value:
+                        fn.returns_host_value = True
+                        fn.host_value_reason = (
+                            f"returns {callee}(...) → "
+                            f"{target.host_value_reason or 'per-host value'}"
+                        )
+                        changed = True
+                    if fn.return_dims is None and \
+                            target.return_dims is not None:
+                        fn.return_dims = list(target.return_dims)
+                        changed = True
                 # returns_donating through factory-of-factory returns
                 for callee in fn.returns_calls:
                     hit = self.lookup_from(mod, callee)
@@ -1251,7 +1785,12 @@ class ProjectIndex:
         bind_edges: list[tuple[str | None, str, set]] = []
         call_edges: list[tuple[str, str]] = []
         lex_edges: list[tuple[str, str]] = []
+        reach: set[str] = set()
         for mod in self.modules.values():
+            if mod.relpath in MULTIHOST_SEED_RELPATHS:
+                reach.update(
+                    f"{mod.module}.{qual}" for qual in mod.axis_funcs
+                )
             for b in mod.axis_bindings:
                 t = self._axis_lookup(mod.module, b.target)
                 if t is None:
@@ -1292,6 +1831,19 @@ class ProjectIndex:
                 break
         self.axis_env = {k: frozenset(v) for k, v in env.items()}
         self.axis_context = ctx
+        # forward closure over the same resolved edges: a helper a seed
+        # module calls (transitively) runs at the same rendezvous points
+        for _ in range(_FIXPOINT_MAX_ROUNDS):
+            before = len(reach)
+            for caller, t in call_edges:
+                if caller in reach:
+                    reach.add(t)
+            for parent, child in lex_edges:
+                if parent in reach:
+                    reach.add(child)
+            if len(reach) == before:
+                break
+        self.multihost_reach = frozenset(reach)
 
 
 def _summarize_path(
@@ -1306,8 +1858,13 @@ def _summarize_path(
             module=module_name_for(relpath), relpath=relpath,
             parse_error=True,
         ), None, None
-    summary = summarize_module(tree, relpath)
-    mesh = scrape_mesh_decl(tree) if relpath == MESH_RELPATH else None
+    summary = summarize_module(tree, relpath, source=source)
+    if relpath == MESH_RELPATH:
+        mesh = scrape_mesh_decl(tree)
+    elif relpath == SUBMESH_RELPATH:
+        mesh = scrape_submesh_axes(tree)
+    else:
+        mesh = None
     return summary, mesh, (source, tree)
 
 
@@ -1327,6 +1884,8 @@ def _save_cache(path: str, data: dict) -> None:
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(data, f)
+            f.flush()
+            os.fsync(f.fileno())  # durable before the rename publishes it
         os.replace(tmp, path)
     except OSError:
         pass  # caching is best-effort; never fail the lint over it

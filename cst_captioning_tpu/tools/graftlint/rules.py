@@ -31,6 +31,8 @@ from cst_captioning_tpu.tools.graftlint.project import (
     _last,
     COLLECTIVE_AXIS_KWARGS,
     COLLECTIVE_AXIS_POS,
+    HostTaint,
+    MULTIHOST_SEED_RELPATHS,
     ProjectIndex,
     def_qualnames,
     donation_of_call,
@@ -2195,3 +2197,599 @@ class DonationFlowRule(ProjectRule):
                 severity="warning",
             ))
         return out
+
+
+# ---- GL018: regex partition-rule table coverage and shadowing ---------------
+
+_DEFAULT_CONTRACT = "scripts/shardings_contract.json"
+
+
+def _delete_element_fix(ctx: FileContext, elt: ast.AST,
+                        description: str) -> Fix:
+    """Span-delete one tuple/list element plus its trailing comma; when
+    the element owns its line(s) outright, take the whole lines so no
+    blank husk is left behind."""
+    start_line, start_col = elt.lineno, elt.col_offset
+    end_line = int(elt.end_lineno or elt.lineno)
+    end_col = int(elt.end_col_offset or elt.col_offset)
+    tail = ctx.lines[end_line - 1][end_col:] if end_line <= len(ctx.lines) \
+        else ""
+    i = 0
+    while i < len(tail) and tail[i] in " \t":
+        i += 1
+    if i < len(tail) and tail[i] == ",":
+        i += 1
+        end_col += i
+        tail = tail[i:]
+    prefix = ctx.lines[start_line - 1][:start_col]
+    if not prefix.strip() and not tail.strip() and end_line < len(ctx.lines):
+        return Fix(edits=(Edit(line=start_line, col=0,
+                               end_line=end_line + 1, end_col=0,
+                               replacement=""),),
+                   description=description)
+    return Fix(edits=(Edit(line=start_line, col=start_col,
+                           end_line=end_line, end_col=end_col,
+                           replacement=""),),
+               description=description)
+
+
+@register
+class PartitionTableShadowingRule(Rule):
+    """GL007 generalized to EVERY ``*PARTITION_RULES`` regex table (the
+    flagship-XL refactor introduces per-subsystem tables): coverage and
+    first-match-wins shadowing against the sharding contract.
+
+    Coverage findings (rule matches nothing / param matched by nothing)
+    are skipped for the canonical ``PARAM_PARTITION_RULES`` table — GL007
+    owns those there — but shadowing is checked everywhere: a row whose
+    every contract match is already claimed by earlier rows can never be
+    selected, and deleting it is provably behavior-identical (the
+    autofix)."""
+
+    id = "GL018"
+    name = "partition-rule-shadowing"
+    severity = "error"
+    rationale = (
+        "first-match-wins regex rule tables rot silently: a later rule "
+        "fully shadowed by earlier ones is dead code that reads like a "
+        "live sharding decision, and in non-canonical tables a rule "
+        "matching nothing (or a param matched by nothing) means the "
+        "table drifted from the contract dump"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return "PARTITION_RULES" in ctx.source and not _is_test_file(ctx)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        contract_rel = None
+        tables: list[tuple[str, ast.Assign]] = []
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for name in _bound_names(node):
+                if name.endswith("PARTITION_RULES"):
+                    tables.append((name, node))
+                if name == "SHARDING_CONTRACT" and isinstance(
+                    node.value, ast.Constant
+                ):
+                    contract_rel = str(node.value.value)
+        if not tables:
+            return []
+        if contract_rel is None:
+            contract_rel = _DEFAULT_CONTRACT
+        contract_path = contract_rel if os.path.isabs(contract_rel) else \
+            os.path.join(ctx.root, contract_rel)
+        try:
+            with open(contract_path, encoding="utf-8") as f:
+                params = list(json.load(f)["params"])
+        except (OSError, ValueError, KeyError):
+            return []  # no readable contract: GL007 reports the canonical
+            # table's missing contract; nothing is checkable here
+
+        out: list[Finding] = []
+        for table_name, node in tables:
+            out.extend(self._check_table(ctx, table_name, node, params))
+        return out
+
+    def _check_table(self, ctx: FileContext, table_name: str,
+                     node: ast.Assign, params: list[str]) -> list[Finding]:
+        canonical = table_name == "PARAM_PARTITION_RULES"
+        elts = getattr(node.value, "elts", [])
+        rows: list[tuple[str, str, ast.AST]] = []
+        for elt in elts:
+            parts = getattr(elt, "elts", [])
+            if len(parts) >= 2 and isinstance(parts[0], ast.Constant) \
+                    and isinstance(parts[1], ast.Constant):
+                rows.append((str(parts[0].value), str(parts[1].value), elt))
+        if not rows or len(rows) != len(elts):
+            # dynamically-built (or partially literal) table: single-file
+            # analysis provably cannot check it — never guess
+            return []
+        out: list[Finding] = []
+        claimed: set[str] = set()
+        unruled = set(params)
+        for family, pattern, elt in rows:
+            try:
+                rx = re.compile(pattern)
+            except re.error as e:
+                if not canonical:  # GL007 reports this on the canonical
+                    out.append(ctx.finding(
+                        self, elt,
+                        f"{table_name} rule {family!r} has an invalid "
+                        f"regex: {e}",
+                    ))
+                continue
+            matched = {p for p in params if rx.fullmatch(p)}
+            unruled -= matched
+            if not matched:
+                if not canonical:
+                    out.append(ctx.finding(
+                        self, elt,
+                        f"{table_name} rule {family!r} ({pattern!r}) "
+                        "matches no parameter in the contract dump — the "
+                        "family it was written for was renamed or removed",
+                    ))
+            elif matched <= claimed:
+                out.append(ctx.finding(
+                    self, elt,
+                    f"{table_name} rule {family!r} ({pattern!r}) is fully "
+                    "shadowed: every contract param it matches is already "
+                    "claimed by an earlier rule, so under first-match-wins "
+                    "this row can never be selected — it is dead code that "
+                    "reads like a live sharding decision",
+                    fix=_delete_element_fix(
+                        ctx, elt,
+                        f"delete dead {table_name} rule {family!r} "
+                        "(fully shadowed by earlier rules)",
+                    ),
+                ))
+            claimed |= matched
+        if not canonical:
+            for p in sorted(unruled):
+                out.append(ctx.finding(
+                    self, node,
+                    f"parameter {p!r} (from the contract dump) matches no "
+                    f"{table_name} rule: add a rule for its family so its "
+                    "sharding is an explicit decision",
+                ))
+        return out
+
+
+# ---- GL019: cross-host collective operand drift -----------------------------
+
+_GL019_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "pbroadcast", "pcast", "ppermute",
+    "process_allgather", "broadcast_one_to_all",
+}
+
+
+class _DriftFlow:
+    """Source-order walk of one scope, mirroring the pass-1 summarizer's
+    statement order but with the project index plugged into the
+    :class:`~.project.HostTaint` environment, so calls to functions whose
+    summaries carry host facts (``returns_host_shape`` /
+    ``returns_host_value``, propagated by the fixpoint) taint their
+    results here. At every collective call site the operand's abstract
+    shape/wire-dtype is checked for per-host dependence."""
+
+    def __init__(self, rule: Rule, ctx: FileContext, index: ProjectIndex,
+                 aliases: dict[str, str], module: str):
+        self.rule = rule
+        self.ctx = ctx
+        self.aliases = aliases
+        self.index = index
+        self.module = module
+        self.env = HostTaint(aliases, lookup=self._lookup)
+        self.findings: list[Finding] = []
+
+    def _lookup(self, dotted: str):
+        hit = self.index.lookup_from(self.module, dotted)
+        return hit[1] if hit else None
+
+    def run(self, body: list[ast.stmt]) -> list[Finding]:
+        for stmt in body:
+            self._stmt(stmt)
+        return self.findings
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, _FUNC_NODES + (ast.Lambda, ast.ClassDef)):
+            return  # separate scopes, each gets its own flow
+        if isinstance(node, ast.Assign):
+            self._expr(node.value)
+            self._bind(node.targets, node.value)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if node.value is not None:
+                self._expr(node.value)
+                self._bind([node.target], node.value)
+        elif isinstance(node, ast.If):
+            self._expr(node.test)
+            for stmt in node.body + node.orelse:
+                self._stmt(stmt)
+            reason = self.env.value_taint(node.test)
+            if reason:
+                self.env.taint_branch_stores(node.body + node.orelse,
+                                             reason)
+        elif isinstance(node, ast.For):
+            self._expr(node.iter)
+            for stmt in node.body + node.orelse:
+                self._stmt(stmt)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+                else:
+                    self._stmt(child)
+
+    def _bind(self, targets: list[ast.AST], value: ast.AST) -> None:
+        names: list[str] = []
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    inner = e.value if isinstance(e, ast.Starred) else e
+                    if isinstance(inner, ast.Name):
+                        names.append(inner.id)
+        if names:
+            self.env.bind(names, value)
+
+    def _expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            resolved = resolve_dotted(_dotted(node.func), self.aliases)
+            if _last(resolved) not in _GL019_COLLECTIVES:
+                continue
+            operand = node.args[0]
+            reason = self.env.shape_taint(operand)
+            if not reason:
+                continue
+            opname = _unparse(operand) or "operand"
+            self.findings.append(self.ctx.finding(
+                self.rule, node,
+                f"{_last(resolved)}(...) operand {opname!r} has a "
+                f"per-host shape or wire dtype ({reason}): every "
+                "participating host must pass identically-shaped, "
+                "identically-typed operands to a collective, or the pod "
+                "deadlocks with no traceback — derive the size/dtype "
+                "from globally-consistent values (process_allgather the "
+                "lengths first, pad to the gathered max)",
+            ))
+
+
+@register
+class CollectiveOperandDriftRule(ProjectRule):
+    id = "GL019"
+    name = "cross-host-collective-operand-drift"
+    severity = "error"
+    rationale = (
+        "a collective whose operand shape or wire dtype depends on "
+        "per-host values (len(local_devices), a process_index-"
+        "conditional branch, a ragged bucket tail) hangs the whole pod "
+        "at the rendezvous with no traceback; the shape-sharding "
+        "environment proves per-host dependence at every collective "
+        "reachable from train/multihost.py or the comms bucket path"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # package code only, minus the linter itself
+        return _in_package(ctx) and not ctx.relpath.startswith(
+            "cst_captioning_tpu/tools/"
+        )
+
+    def check_project(self, ctx: FileContext,
+                      index: ProjectIndex) -> list[Finding]:
+        mod = index.by_relpath.get(ctx.relpath)
+        if mod is None or mod.parse_error:
+            return []
+        seeded = ctx.relpath in MULTIHOST_SEED_RELPATHS
+        if not seeded and not any(
+            q.startswith(f"{mod.module}.")
+            for q in index.multihost_reach
+        ):
+            return []
+        aliases = index.aliases_for(ctx.relpath, ctx.tree)
+        quals = def_qualnames(ctx.tree)
+        out: list[Finding] = []
+        for node in ctx.nodes_of(*_FUNC_NODES):
+            full = f"{mod.module}.{quals.get(id(node), node.name)}"
+            if not seeded and full not in index.multihost_reach:
+                continue
+            flow = _DriftFlow(self, ctx, index, aliases, mod.module)
+            out.extend(flow.run(node.body))
+        if seeded:
+            # module-level collectives in a seed module are in scope too
+            flow = _DriftFlow(self, ctx, index, aliases, mod.module)
+            out.extend(flow.run(ctx.tree.body))
+        return out
+
+
+# ---- GL020: Pallas kernel contract lint -------------------------------------
+
+# VMEM is ~16 MiB/core; a kernel whose resident blocks + scratch exceed
+# it fails to fit long before the compiler says anything useful
+_GL020_VMEM_BUDGET = 16 * 1024 * 1024
+_GL020_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+}
+
+
+@register
+class PallasContractRule(Rule):
+    """Single-file, purely structural checks over ``pl.pallas_call``
+    sites: index-map arity vs grid rank, block-shape/grid divisibility
+    (the ``grid=(M // bm,)`` + ``BlockSpec((bm, ...))`` pairing — a block
+    dim paired with a floor-divided grid dim must reuse the same divisor
+    unless the kernel body visibly guards with ``pl.when``), and a
+    resolvable-only VMEM footprint estimate. Opaque specs (built by
+    helpers, unpacked from tuples) are skipped — single-file analysis
+    provably cannot see them, so it never guesses."""
+
+    id = "GL020"
+    name = "pallas-kernel-contract"
+    severity = "error"
+    rationale = (
+        "BlockSpec contracts live only in comments and runtime asserts "
+        "today: an index map whose arity drifts from the grid rank fails "
+        "deep in lowering, a block shape that stops dividing a reshaped "
+        "grid dim silently reads garbage in the tail block unless "
+        "pl.when-guarded, and a kernel whose blocks + scratch exceed the "
+        "~16 MiB VMEM budget fails to fit at compile time"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return "pallas_call" in ctx.source and not _is_test_file(ctx)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        out: list[Finding] = []
+        name_defs = {
+            node.name: node for node in ctx.nodes_of(*_FUNC_NODES)
+        }
+        for call in ctx.nodes_of(ast.Call):
+            if _last(_dotted(call.func)) != "pallas_call":
+                continue
+            out.extend(self._check_site(ctx, call, name_defs))
+        return out
+
+    # -- per-site ---------------------------------------------------------
+
+    def _check_site(self, ctx: FileContext, call: ast.Call,
+                    name_defs: dict) -> list[Finding]:
+        env = self._local_env(ctx, call)
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        grid = self._resolve(kw.get("grid"), env)
+        if not isinstance(grid, ast.Tuple):
+            return []  # no literal grid: nothing checkable single-file
+        rank = len(grid.elts)
+        # grid dim -> divisor token when the extent is `X // d`
+        divisors: list[str | None] = []
+        for elt in grid.elts:
+            e = self._resolve(elt, env)
+            if isinstance(e, ast.BinOp) and isinstance(e.op, ast.FloorDiv):
+                divisors.append(_unparse(e.right))
+            else:
+                divisors.append(None)
+        guarded = self._kernel_has_when(call, env, name_defs)
+
+        out: list[Finding] = []
+        specs: list[ast.AST] = []
+        for key in ("in_specs", "out_specs"):
+            v = self._resolve(kw.get(key), env)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                specs.extend(v.elts)
+            elif v is not None:
+                specs.append(v)
+        block_bytes = 0
+        resolvable = True
+        for spec in specs:
+            spec = self._resolve(spec, env)
+            if not isinstance(spec, ast.Call) or \
+                    _last(_dotted(spec.func)) != "BlockSpec":
+                resolvable = False
+                continue  # opaque spec: provably cannot analyze it here
+            shape_node = self._resolve(
+                spec.args[0] if spec.args else None, env
+            )
+            imap = self._resolve(
+                spec.args[1] if len(spec.args) > 1 else None, env
+            )
+            for k in spec.keywords:
+                if k.arg == "index_map":
+                    imap = self._resolve(k.value, env)
+            if isinstance(imap, ast.Lambda):
+                arity = len(imap.args.args) + len(imap.args.posonlyargs)
+                if arity != rank:
+                    out.append(ctx.finding(
+                        self, spec,
+                        f"BlockSpec index map takes {arity} argument(s) "
+                        f"but the grid has rank {rank}: pallas passes one "
+                        "program index per grid dim, so this fails at "
+                        "trace time — keep the lambda arity equal to the "
+                        "grid rank",
+                    ))
+                elif not guarded and isinstance(imap.body, ast.Tuple):
+                    out.extend(self._divisibility(
+                        ctx, spec, shape_node, imap, divisors, env
+                    ))
+            nbytes = self._block_nbytes(shape_node, env, dtype="float32")
+            if nbytes is None:
+                resolvable = False
+            else:
+                block_bytes += nbytes
+        scratch_bytes = self._scratch_nbytes(
+            self._resolve(kw.get("scratch_shapes"), env), env
+        )
+        if scratch_bytes is None:
+            resolvable = False
+            scratch_bytes = 0
+        total = block_bytes + scratch_bytes
+        if resolvable and specs and total > _GL020_VMEM_BUDGET:
+            out.append(ctx.finding(
+                self, call,
+                f"estimated VMEM footprint {total / 2**20:.1f} MiB "
+                "(resident blocks + scratch at declared dtypes) exceeds "
+                f"the ~{_GL020_VMEM_BUDGET // 2**20} MiB per-core budget: "
+                "shrink the block shapes or spill stages to HBM",
+                severity="warning",
+            ))
+        return out
+
+    def _divisibility(self, ctx: FileContext, spec: ast.AST,
+                      shape_node: ast.AST | None, imap: ast.Lambda,
+                      divisors: list, env: dict) -> list[Finding]:
+        """Block dim j paired (via a bare index-map param) with grid dim k
+        whose extent is `X // d` must BE d (or 1): anything else walks the
+        array with a stride the grid was not built for."""
+        if not isinstance(shape_node, (ast.Tuple, ast.List)):
+            return []
+        params = [a.arg for a in imap.args.posonlyargs + imap.args.args]
+        out: list[Finding] = []
+        for j, idx_expr in enumerate(imap.body.elts):
+            if not isinstance(idx_expr, ast.Name) or \
+                    idx_expr.id not in params:
+                continue  # derived index (e.g. jnp.maximum(g-1, 0)):
+                # the mapping is deliberate, not a stride contract
+            k = params.index(idx_expr.id)
+            if k >= len(divisors) or divisors[k] is None:
+                continue
+            if j >= len(shape_node.elts):
+                continue
+            dim = self._resolve(shape_node.elts[j], env)
+            dim_txt = _unparse(shape_node.elts[j])
+            if isinstance(dim, ast.Constant) and dim.value == 1:
+                continue
+            if dim_txt == divisors[k] or _unparse(dim) == divisors[k]:
+                continue
+            out.append(ctx.finding(
+                self, spec,
+                f"BlockSpec block dim {j} ({dim_txt!r}) indexes grid dim "
+                f"{k}, whose extent is divided by {divisors[k]!r}: the "
+                "block dim and the grid divisor must be the same value "
+                "(or the kernel must guard the tail with pl.when/"
+                "masking), otherwise the last block reads out of bounds",
+            ))
+        return out
+
+    # -- resolution helpers ----------------------------------------------
+
+    def _local_env(self, ctx: FileContext, call: ast.Call) -> dict:
+        """name -> value node for single-Name assigns (and int parameter
+        defaults) of the def enclosing ``call``; module scope otherwise."""
+        cache = ctx._cache.setdefault("gl020_envs", {})
+        owner = None
+        for node in ctx.nodes_of(*_FUNC_NODES):
+            if node.lineno <= call.lineno <= (node.end_lineno or 0):
+                if owner is None or node.lineno > owner.lineno:
+                    owner = node  # innermost enclosing def
+        key = id(owner) if owner is not None else 0
+        if key in cache:
+            return cache[key]
+        env: dict[str, ast.AST] = {}
+        if owner is not None:
+            args = owner.args
+            pos = args.posonlyargs + args.args
+            for a, d in zip(pos[len(pos) - len(args.defaults):],
+                            args.defaults):
+                env[a.arg] = d
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if d is not None:
+                    env[a.arg] = d
+        body = owner.body if owner is not None else ctx.tree.body
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNC_NODES + (ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                env[node.targets[0].id] = node.value
+            stack.extend(ast.iter_child_nodes(node))
+        cache[key] = env
+        return env
+
+    @staticmethod
+    def _resolve(node: ast.AST | None, env: dict,
+                 depth: int = 4) -> ast.AST | None:
+        while depth > 0 and isinstance(node, ast.Name) and node.id in env:
+            node = env[node.id]
+            depth -= 1
+        return node
+
+    def _kernel_has_when(self, call: ast.Call, env: dict,
+                         name_defs: dict) -> bool:
+        kernel = call.args[0] if call.args else None
+        if isinstance(kernel, ast.Call) and \
+                _last(_dotted(kernel.func)) == "partial" and kernel.args:
+            kernel = kernel.args[0]
+        if isinstance(kernel, ast.Name):
+            fn = name_defs.get(kernel.id)
+            if fn is None:
+                return True  # unknown kernel body: assume it guards
+            return any(
+                isinstance(n, ast.Call) and _last(_dotted(n.func)) == "when"
+                for n in ast.walk(fn)
+            )
+        return True  # lambda/opaque kernel: never guess
+
+    def _int_of(self, node: ast.AST | None, env: dict) -> int | None:
+        node = self._resolve(node, env)
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return node.value
+        if isinstance(node, ast.BinOp):
+            lhs = self._int_of(node.left, env)
+            rhs = self._int_of(node.right, env)
+            if lhs is None or rhs is None:
+                return None
+            if isinstance(node.op, ast.Mult):
+                return lhs * rhs
+            if isinstance(node.op, ast.Add):
+                return lhs + rhs
+            if isinstance(node.op, ast.Sub):
+                return lhs - rhs
+            if isinstance(node.op, ast.FloorDiv) and rhs:
+                return lhs // rhs
+        return None
+
+    def _block_nbytes(self, shape_node: ast.AST | None, env: dict,
+                      dtype: str) -> int | None:
+        if not isinstance(shape_node, (ast.Tuple, ast.List)):
+            return None
+        total = _GL020_DTYPE_BYTES.get(dtype, 4)
+        for elt in shape_node.elts:
+            if isinstance(self._resolve(elt, env), ast.Constant) and \
+                    self._resolve(elt, env).value is None:
+                continue  # None block dim: whole-axis, sized elsewhere
+            v = self._int_of(elt, env)
+            if v is None:
+                return None
+            total *= v
+        return total
+
+    def _scratch_nbytes(self, node: ast.AST | None,
+                        env: dict) -> int | None:
+        """Total bytes of ``scratch_shapes=[pltpu.VMEM(shape, dtype),…]``;
+        None = present but unresolvable, 0 = absent."""
+        if node is None:
+            return 0
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            return None
+        total = 0
+        for elt in node.elts:
+            elt = self._resolve(elt, env)
+            if not isinstance(elt, ast.Call) or \
+                    _last(_dotted(elt.func)) not in ("VMEM", "SMEM"):
+                return None
+            shape = self._resolve(
+                elt.args[0] if elt.args else None, env
+            )
+            dtype = _last(_dotted(elt.args[1])) if len(elt.args) > 1 \
+                else "float32"
+            n = self._block_nbytes(shape, env, dtype=dtype or "float32")
+            if n is None:
+                return None
+            total += n
+        return total

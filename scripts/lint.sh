@@ -15,12 +15,20 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# Fast pre-commit path first: pass 1 still builds (and warms) the full
+# whole-program index, pass 2 runs only on files git says changed vs HEAD —
+# sub-second on a one-file diff, so a dirty tree fails in the cheap pass
+# before the authoritative full-tree gate below spends its budget.
+python -m cst_captioning_tpu.tools.graftlint --changed-only --timings
+
 # Two-pass AST analysis only — no JAX backend, no device. Pass 1 builds the
 # whole-program project index (mtime-keyed summary cache keeps repeat runs
-# warm; now carrying the per-function axis environments and donation facts
-# that power GL016/GL017), pass 2 runs the per-file + interprocedural
-# rules. --timings prints the per-pass line; --budget asserts index+rules
-# stay under 2 s.
+# warm; now carrying the per-function axis environments, donation facts,
+# and the shape/dtype/sharding environments that power GL016–GL020),
+# pass 2 runs the per-file + interprocedural rules. --timings prints the
+# per-pass line; --budget asserts index+rules stay under 2 s. This
+# full-tree line stays the authoritative gate — --changed-only above is
+# only the fast path.
 python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_comms.py bench_decode.py \

@@ -1,0 +1,22 @@
+"""GL018 fixture — a NON-canonical regex partition-rule table (the name
+ends with ``PARTITION_RULES`` but is not ``PARAM_PARTITION_RULES``, so
+GL018 owns coverage here, not GL007).
+
+Three findings: ``dec_again`` is fully shadowed by the earlier ``dec``
+rule (first-match-wins dead row — the autofix deletes it), ``lstm_gate``
+matches no contract param, and ``params/head/w`` is matched by no rule.
+
+Deliberately lint-dirty directory: skipped by the repo-wide walk
+(``fixtures`` is in core._SKIP_DIRS), linted explicitly by the tests.
+"""
+
+SHARDING_CONTRACT = "scripts/shardings_contract.json"
+
+P = tuple  # stand-in spec type: GL018 only reads the (family, regex) prefix
+
+COMM_PARTITION_RULES = (
+    ("enc", r"params/enc/.*", P()),
+    ("dec", r"params/dec/.*", P()),
+    ("dec_again", r"params/dec/[wb]", P()),
+    ("lstm_gate", r"params/lstm\d+/.*", P()),
+)

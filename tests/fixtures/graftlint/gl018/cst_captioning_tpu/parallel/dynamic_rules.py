@@ -1,0 +1,11 @@
+"""GL018 provably-cannot twin: the table is BUILT dynamically, so its
+rows carry no literal (family, regex) prefix. Single-file analysis
+provably cannot check coverage or shadowing here — the rule must stay
+quiet rather than guess (a partially-parseable table is treated the
+same way: all rows literal, or nothing is claimed)."""
+
+SHARDING_CONTRACT = "scripts/shardings_contract.json"
+
+_BASE = [("enc", r"params/enc/.*"), ("dec", r"params/dec/.*")]
+
+DYN_PARTITION_RULES = tuple((f, p, ()) for f, p in _BASE)

@@ -1,0 +1,18 @@
+"""GL019 helpers. ``local_block`` returns a per-host shape — the pass-1
+summary fact (``returns_host_shape``) that taints its results at call
+sites in other modules. ``sync_ragged`` holds a drifting collective that
+is only a finding because ``train/multihost.py`` calls it (reachability
+closure): linting THIS file alone must find nothing — with the seed
+module absent, nothing proves the site is a cross-host rendezvous."""
+
+import jax
+import jax.numpy as jnp
+
+
+def local_block():
+    return jnp.zeros((jax.local_device_count(), 128), jnp.float32)
+
+
+def sync_ragged(x):
+    tail = jnp.zeros((jax.local_device_count(),), jnp.float32)
+    return jax.lax.psum(tail, "data")  # GL019 only via reachability

@@ -1,0 +1,63 @@
+"""GL019 cross-file fixture — collective operand drift in a replica of
+the seed module (every collective here is a cross-host rendezvous).
+
+Positives: an operand whose leading dim is ``len(jax.local_devices())``,
+an operand shaped differently under a ``process_index()`` branch, and an
+operand returned by a helper whose summary says ``returns_host_shape``
+(the cross-module fixpoint fact). Negatives prove the rule never
+guesses: param-shaped operands, literal shapes, and the canonical
+gather-lengths-then-pad pattern are all provably host-invariant.
+
+Deliberately lint-dirty directory: skipped by the repo-wide walk
+(``fixtures`` is in core._SKIP_DIRS), linted explicitly by the tests.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+from cst_captioning_tpu.parallel.helpers import local_block, sync_ragged
+
+
+def drift_local_devices():
+    ragged = jnp.zeros((len(jax.local_devices()), 128), jnp.float32)
+    return jax.lax.psum(ragged, "data")  # GL019: per-host leading dim
+
+
+def drift_branch():
+    if jax.process_index() == 0:
+        buf = jnp.zeros((4, 128), jnp.float32)
+    else:
+        buf = jnp.zeros((2, 128), jnp.float32)
+    return jax.lax.psum(buf, "data")  # GL019: branch-dependent shape
+
+
+def drift_cross_module():
+    return jax.lax.psum(local_block(), "data")  # GL019: callee fact
+
+
+def drift_suppressed():
+    ragged = jnp.zeros((len(jax.local_devices()), 128), jnp.float32)
+    return jax.lax.psum(ragged, "data")  # graftlint: disable=GL019 (fixture: single-host harness pins one process)
+
+
+def quiet_param(x):
+    # operand shape comes from the caller: unknown, never guess
+    return jax.lax.psum(x, "data")
+
+
+def quiet_literal():
+    return jax.lax.psum(jnp.zeros((8, 128), jnp.float32), "data")
+
+
+def quiet_gathered_pad(data):
+    # the canonical fix: gather the per-host lengths FIRST, then pad to
+    # the gathered max — provably host-invariant
+    lengths = multihost_utils.process_allgather(data.size)
+    padded = jnp.zeros((int(lengths.max()),), jnp.uint8)
+    return multihost_utils.process_allgather(padded)
+
+
+def reach_helper(x):
+    # pulls helpers.sync_ragged into the multihost reachability closure
+    return sync_ragged(x)

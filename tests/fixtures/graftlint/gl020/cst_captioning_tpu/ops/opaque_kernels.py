@@ -1,0 +1,24 @@
+"""GL020 provably-cannot twin: the grid arrives through an attribute and
+the in_specs through a helper call — single-file analysis provably
+cannot resolve either, so the rule must stay quiet rather than guess."""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def make_specs(block):
+    return [pl.BlockSpec((block, block), lambda i: (i, 0))]
+
+
+def opaque(x, cfg):
+    return pl.pallas_call(
+        _kernel,
+        grid=cfg.grid,
+        in_specs=make_specs(cfg.block),
+        out_specs=pl.BlockSpec((cfg.block, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,83 @@
+"""GL020 fixture — Pallas kernel contract violations, one per def.
+
+``arity_mismatch``: index-map arity drifts from the grid rank.
+``stride_mismatch``: a block dim paired with a floor-divided grid dim
+uses a different divisor, and the kernel body has no ``pl.when`` guard.
+``stride_guarded`` is the same pairing but the kernel visibly guards the
+tail — quiet. ``vmem_hog``: fully-resolvable blocks + scratch exceed the
+~16 MiB per-core budget (warning).
+
+Deliberately lint-dirty directory: skipped by the repo-wide walk
+(``fixtures`` is in core._SKIP_DIRS), linted explicitly by the tests.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _guarded_kernel(x_ref, o_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        o_ref[...] = x_ref[...]
+
+
+def arity_mismatch(x, block=128):
+    n, d = x.shape
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(n // block, d // block),
+        in_specs=[pl.BlockSpec((block, block), lambda i: (i, 0))],  # GL020
+        out_specs=pl.BlockSpec((block, block), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def stride_mismatch(x, block_n=128, block_k=64):
+    n, _ = x.shape
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(n // block_n,),
+        in_specs=[pl.BlockSpec((block_k, 128), lambda i: (i, 0))],  # GL020
+        out_specs=pl.BlockSpec((block_n, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def stride_guarded(x, block_n=128, block_k=64):
+    n, _ = x.shape
+    return pl.pallas_call(
+        _guarded_kernel,
+        grid=(n // block_n,),
+        in_specs=[pl.BlockSpec((block_k, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_n, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def arity_suppressed(x, block=128):
+    n, d = x.shape
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(n // block, d // block),
+        in_specs=[pl.BlockSpec((block, block), lambda i: (i, 0))],  # graftlint: disable=GL020 (fixture: grid rank is dynamic upstream)
+        out_specs=pl.BlockSpec((block, block), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def vmem_hog(x):
+    n = 4096
+    return pl.pallas_call(
+        _guarded_kernel,
+        grid=(n // 4096,),
+        in_specs=[pl.BlockSpec((4096, 4096), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((4096, 4096), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, 4096), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((4096, 128), jnp.float32)],
+    )(x)

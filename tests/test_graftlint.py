@@ -1947,3 +1947,352 @@ def test_axis_env_transitive_through_helper_chain(tmp_path):
     for qual in ("leaf", "mid", "run.body"):
         env, has_ctx = idx.axis_env_of("m", qual)
         assert has_ctx and env == frozenset({"data"}), qual
+
+# ---- GL018: partition-rule table coverage & shadowing -----------------------
+
+def test_gl018_shadowed_no_match_and_uncovered():
+    """THE acceptance fixture: a non-canonical regex rule table with a
+    fully-shadowed dead row (autofixable), a rule matching no contract
+    param, and a contract param matched by no rule — three findings; the
+    suppressed twin and the dynamically-built table stay quiet."""
+    findings = _lint_fixture("gl018", ["GL018"])
+    assert _rules_of(findings) == ["GL018"]
+    assert all(f.path.endswith("bucket_rules.py") for f in findings)
+    by_line = {f.line: f for f in findings}
+    assert set(by_line) == {17, 20, 21}
+    # uncovered contract param anchors to the table header
+    assert "params/head/w" in by_line[17].message
+    assert by_line[17].fix is None
+    # dead row: every param it matches is claimed earlier — autofix
+    # deletes it (provably behavior-identical under first-match-wins)
+    assert "dec_again" in by_line[20].message
+    assert "shadowed" in by_line[20].message
+    assert by_line[20].fix is not None
+    # rule whose family was renamed away: matches nothing
+    assert "lstm_gate" in by_line[21].message
+    assert by_line[21].fix is None
+    for f in findings:
+        assert f.severity == "error"
+
+
+def test_gl018_dynamic_table_provably_cannot():
+    """A table built by a comprehension carries no literal (family,
+    regex) rows: single-file analysis provably cannot check it, so the
+    rule stays quiet rather than guess."""
+    assert _lint_fixture(
+        "gl018", ["GL018"],
+        only="cst_captioning_tpu/parallel/dynamic_rules.py",
+    ) == []
+
+
+def test_gl018_canonical_table_shadowing_only(tmp_path):
+    """GL007 owns coverage for the canonical PARAM_PARTITION_RULES —
+    GL018 adds only the shadowing check there (no duplicate no-match /
+    uncovered findings)."""
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "scripts" / "shardings_contract.json").write_text(
+        json.dumps({"params": ["params/enc/w", "params/dec/w",
+                               "params/orphan/w"]})
+    )
+    findings = _lint(tmp_path, "cst_captioning_tpu/train/mesh.py", (
+        "PARAM_PARTITION_RULES = (\n"
+        "    ('enc', r'params/enc/.*', ()),\n"
+        "    ('enc_dup', r'params/enc/w', ()),\n"   # shadowed -> GL018
+        "    ('no_match', r'params/gone/.*', ()),\n"  # GL007's job, not ours
+        ")\n"
+    ), rules=["GL018"])
+    assert len(findings) == 1
+    assert findings[0].line == 3 and "enc_dup" in findings[0].message
+    # params/orphan/w is uncovered, but coverage of the canonical table
+    # belongs to GL007 — GL018 must not double-report it
+    assert all("orphan" not in f.message for f in findings)
+
+
+def test_gl018_fix_deletes_dead_rule_and_is_idempotent(tmp_path, capsys):
+    """--fix removes the provably-dead shadowed row (whole line, trailing
+    comma and all), the tree relints clean, and a second --fix is a
+    byte-for-byte no-op."""
+    _write_repo(tmp_path, {
+        "scripts/shardings_contract.json": json.dumps(
+            {"params": ["params/enc/w", "params/dec/w"]}
+        ),
+        "cst_captioning_tpu/parallel/bucket_rules.py": (
+            "SHARDING_CONTRACT = 'scripts/shardings_contract.json'\n"
+            "COMM_PARTITION_RULES = (\n"
+            "    ('all', r'params/.*', ()),\n"
+            "    ('dup', r'params/dec/.*', ()),\n"
+            ")\n"
+        ),
+    })
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache", "--rules", "GL018"]
+    assert cli_main(args + ["--fix"]) == 0
+    capsys.readouterr()
+    fixed = (
+        tmp_path / "cst_captioning_tpu/parallel/bucket_rules.py"
+    ).read_text()
+    assert "dup" not in fixed and "('all', r'params/.*', ())," in fixed
+    assert cli_main(args) == 0  # clean after the fix
+    before = fixed
+    assert cli_main(args + ["--fix"]) == 0
+    assert (
+        tmp_path / "cst_captioning_tpu/parallel/bucket_rules.py"
+    ).read_text() == before
+
+
+# ---- GL019: cross-host collective operand drift -----------------------------
+
+def test_gl019_cross_file_drift():
+    """THE acceptance fixture: per-host constructor shape, a
+    process_index()-conditional shape, and a callee whose summary says
+    returns_host_shape (plus a helper reached only through the seed
+    module's call closure) all fire; the param-shaped, literal-shaped,
+    and gather-lengths-then-pad negatives stay quiet."""
+    findings = _lint_fixture("gl019", ["GL019"])
+    assert _rules_of(findings) == ["GL019"]
+    sites = {(os.path.basename(f.path), f.line) for f in findings}
+    assert sites == {
+        ("helpers.py", 18),     # reachability-only finding
+        ("multihost.py", 24),   # len(jax.local_devices()) leading dim
+        ("multihost.py", 32),   # branch-dependent shape
+        ("multihost.py", 36),   # cross-module returns_host_shape fact
+    }
+    for f in findings:
+        assert f.severity == "error"
+        # every message names the canonical repair
+        assert "process_allgather" in f.message
+    by_site = {(os.path.basename(f.path), f.line): f for f in findings}
+    assert "local_devices" in by_site[("multihost.py", 24)].message
+    assert "branch" in by_site[("multihost.py", 32)].message
+    assert "local_block" in by_site[("multihost.py", 36)].message
+
+
+def test_gl019_single_file_provably_cannot():
+    """Linting the helper module ALONE must find nothing: without the
+    seed module in the index, nothing proves its psum is a cross-host
+    rendezvous (the reachability closure is empty)."""
+    assert _lint_fixture(
+        "gl019", ["GL019"],
+        only="cst_captioning_tpu/parallel/helpers.py",
+    ) == []
+
+
+def test_gl019_host_value_reduction_is_fine(tmp_path):
+    """VALUE host-dependence is the point of a reduction — only shape /
+    wire-dtype drift deadlocks. A psum OVER a per-host value with a
+    host-invariant shape must stay quiet."""
+    findings = _lint(tmp_path, "cst_captioning_tpu/train/multihost.py", (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def count_devices():\n"
+        "    n = float(jax.local_device_count())\n"
+        "    return jax.lax.psum(jnp.float32(n), 'data')\n"
+    ), rules=["GL019"])
+    assert findings == []
+
+
+# ---- GL020: Pallas kernel contract ------------------------------------------
+
+def test_gl020_arity_divisibility_and_vmem():
+    """THE acceptance fixture: index-map arity vs grid rank (error),
+    block dim vs grid divisor without a pl.when guard (error), and a
+    fully-resolvable VMEM estimate over the ~16 MiB budget (warning);
+    the guarded twin and the suppressed twin stay quiet."""
+    findings = _lint_fixture("gl020", ["GL020"])
+    assert _rules_of(findings) == ["GL020"]
+    assert all(f.path.endswith("toy_kernels.py") for f in findings)
+    by_line = {f.line: f for f in findings}
+    assert set(by_line) == {35, 46, 76}
+    assert "arity" in by_line[35].message or "argument" in by_line[35].message
+    assert by_line[35].severity == "error"
+    assert "block_k" in by_line[46].message
+    assert "block_n" in by_line[46].message
+    assert by_line[46].severity == "error"
+    assert "VMEM" in by_line[76].message and "MiB" in by_line[76].message
+    assert by_line[76].severity == "warning"
+
+
+def test_gl020_opaque_site_provably_cannot():
+    """grid through an attribute, in_specs through a helper call:
+    single-file analysis provably cannot resolve either — quiet, never
+    guess."""
+    assert _lint_fixture(
+        "gl020", ["GL020"],
+        only="cst_captioning_tpu/ops/opaque_kernels.py",
+    ) == []
+
+
+# ---- cache: corruption, v5 fields, submesh scrape ---------------------------
+
+def test_corrupt_cache_falls_back_to_cold(tmp_path):
+    """A truncated / garbage cache file (the failure the atomic
+    tmp-then-rename write prevents) must cold-start cleanly, then leave
+    a valid cache behind."""
+    from cst_captioning_tpu.tools.graftlint import ProjectIndex
+    from cst_captioning_tpu.tools.graftlint.project import _CACHE_VERSION
+
+    mod = tmp_path / "m.py"
+    mod.write_text("def f():\n    return 1\n")
+    cache = tmp_path / "cache.json"
+    cache.write_text('{"version": 5, "files": {')  # torn mid-write
+    idx = ProjectIndex.build([str(mod)], str(tmp_path),
+                             cache_path=str(cache))
+    assert idx.stats.summarized == 1 and idx.stats.cached == 0
+    data = json.loads(cache.read_text())  # rewritten valid
+    assert data["version"] == _CACHE_VERSION
+    warm = ProjectIndex.build([str(mod)], str(tmp_path),
+                              cache_path=str(cache))
+    assert warm.stats.cached == 1 and warm.stats.summarized == 0
+
+
+def test_cache_round_trips_shape_and_host_facts(tmp_path):
+    """The v5 summary fields (literal dims, PartitionSpec bindings,
+    host-shape provenance) must serve identically from a warm cache."""
+    from cst_captioning_tpu.tools.graftlint import ProjectIndex
+
+    (tmp_path / "lib.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def local_block():\n"
+        "    return jnp.zeros((jax.local_device_count(), 128),\n"
+        "                     jnp.float32)\n"
+        "def buf():\n"
+        "    x = jnp.zeros((8, 128), jnp.bfloat16)\n"
+        "    spec = P('data', None)\n"
+        "    return x\n"
+    )
+    cache = tmp_path / "cache.json"
+    files = [str(tmp_path / "lib.py")]
+    cold = ProjectIndex.build(files, str(tmp_path), cache_path=str(cache))
+    warm = ProjectIndex.build(files, str(tmp_path), cache_path=str(cache))
+    assert warm.stats.cached == 1 and warm.stats.summarized == 0
+    for idx in (cold, warm):
+        host = idx.functions["lib.local_block"]
+        assert host.returns_host_shape
+        assert "local_device_count" in host.host_shape_reason
+        plain = idx.functions["lib.buf"]
+        assert plain.array_dims["x"] == [8, 128]
+        assert plain.pspec_vars["spec"] == ["data", None]
+        assert plain.return_dims == [8, 128]
+        assert not plain.returns_host_shape
+
+
+def test_submesh_axes_merge_into_mesh_decl(tmp_path):
+    """parallel/submesh.py axis declarations join the train/mesh.py
+    scrape, so GL012 treats the actor/learner submesh axis as declared."""
+    from cst_captioning_tpu.tools.graftlint import ProjectIndex
+
+    mesh = tmp_path / "cst_captioning_tpu" / "train" / "mesh.py"
+    mesh.parent.mkdir(parents=True)
+    mesh.write_text("def make_mesh(axis='data'):\n    return axis\n")
+    sub = tmp_path / "cst_captioning_tpu" / "parallel" / "submesh.py"
+    sub.parent.mkdir(parents=True)
+    sub.write_text(
+        "def plan_submesh(mesh, rollout_axis='actor'):\n"
+        "    return rollout_axis\n"
+    )
+    mod = tmp_path / "cst_captioning_tpu" / "mod.py"
+    mod.write_text(
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.lax.psum(x, 'actor')\n"
+    )
+    idx = ProjectIndex.build(
+        [str(mesh), str(sub), str(mod)], str(tmp_path), cache_path="",
+    )
+    assert {"data", "actor"} <= set(idx.mesh.axes)
+    result = lint_paths([str(mod)], str(tmp_path), rule_ids=["GL012"],
+                        cache_path="")
+    assert result.findings == []
+    # contrast: without submesh.py the same axis IS a GL012 typo
+    sub.unlink()
+    result = lint_paths([str(mod)], str(tmp_path), rule_ids=["GL012"],
+                        cache_path="")
+    assert _rules_of(result.findings) == ["GL012"]
+
+
+# ---- README drift pin -------------------------------------------------------
+
+def test_readme_rule_table_tracks_registry():
+    """Every registered rule id appears in README's Static analysis rule
+    table, and every GLxxx the README mentions is a live registered rule
+    (no retired ids lingering in the docs)."""
+    import re
+
+    readme = open(os.path.join(REPO, "README.md")).read()
+    registered = set(all_rules())
+    mentioned = set(re.findall(r"\bGL\d{3}\b", readme))
+    missing = {
+        rid for rid in registered
+        if not re.search(rf"\*\*{rid}\b", readme)
+    }
+    assert not missing, f"rules missing from README table: {sorted(missing)}"
+    retired = mentioned - registered
+    assert not retired, f"README names unregistered rules: {sorted(retired)}"
+
+
+# ---- --changed-only: the git-scoped fast path -------------------------------
+
+def _git(tmp_path, *argv):
+    subprocess.run(
+        ["git", "-C", str(tmp_path), "-c", "user.email=ci@example.com",
+         "-c", "user.name=ci", *argv],
+        check=True, capture_output=True,
+    )
+
+
+def test_changed_only_scopes_pass_two_to_the_diff(tmp_path, capsys):
+    """Pass 1 still indexes the whole tree, but findings come only from
+    files git reports changed: a pre-existing finding in an UNTOUCHED
+    file stays out of the fast path (the full-tree gate owns it)."""
+    files = dict(_FIXABLE_GL013)  # consumer.py holds the GL013 finding
+    _write_repo(tmp_path, files)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    args = [str(tmp_path / "cst_captioning_tpu"), "--root", str(tmp_path),
+            "--no-cache", "--changed-only"]
+    # clean tree: nothing to lint, exit 0
+    assert cli_main(args) == 0
+    assert "no changed" in capsys.readouterr().err
+    # touch ONLY the clean producer: consumer's finding must not gate
+    # the fast path
+    prod = tmp_path / "cst_captioning_tpu/producer.py"
+    prod.write_text(prod.read_text() + "\n# tuning note\n")
+    assert cli_main(args) == 0
+    err = capsys.readouterr().err
+    assert "1 file(s), 0 finding(s)" in err
+    # now dirty the consumer too: its finding rides the fast path
+    assert cli_main(args + ["--rules", "GL013"]) == 0  # not changed yet
+    capsys.readouterr()
+    cons = tmp_path / "cst_captioning_tpu/consumer.py"
+    cons.write_text(cons.read_text() + "\n# touched\n")
+    assert cli_main(args) == 1
+    out = capsys.readouterr()
+    assert "GL013" in out.out and "2 file(s)" in out.err
+
+
+def test_changed_only_excludes_authoritative_gates(tmp_path, capsys):
+    _write_repo(tmp_path, {})
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    base = [str(tmp_path), "--root", str(tmp_path), "--changed-only"]
+    for gate in ("--fix", "--fix-check", "--write-baseline"):
+        assert cli_main(base + [gate]) == 2
+        assert "exclusive" in capsys.readouterr().err
+    assert cli_main(base + ["--check-stale"]) == 2
+
+
+def test_changed_only_outside_git_is_a_usage_error(tmp_path, capsys):
+    _write_repo(tmp_path, {"cst_captioning_tpu/m.py": "X = 1\n"})
+    env = dict(os.environ, GIT_DIR=str(tmp_path / "nope" / ".git"),
+               GIT_CEILING_DIRECTORIES=str(tmp_path))
+    rc = subprocess.run(
+        [sys.executable, "-m", "cst_captioning_tpu.tools.graftlint",
+         "cst_captioning_tpu", "--root", str(tmp_path), "--changed-only"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    assert rc.returncode == 2
+    assert "git checkout" in rc.stderr
