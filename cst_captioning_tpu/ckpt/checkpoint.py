@@ -303,15 +303,24 @@ class CheckpointManager:
             cands.append((-1 if step is None else int(step), role, entry))
         return [e for _, _, e in sorted(cands, reverse=True)]
 
-    def restore_latest(self, template: TrainState) -> tuple[TrainState, dict] | None:
+    def restore_latest(self, template: TrainState,
+                       prefer: str | None = None) -> tuple[TrainState, dict] | None:
         """Auto-resume: newest checkpoint that passes verification.
 
         A corrupt/partial candidate is never silently skipped: each failure
         is logged as a structured ``ckpt_corrupt`` event (candidate name,
         error class, detail) AND counts on ``resilience.ckpt_corrupt``
-        before falling back to the next generation."""
+        before falling back to the next generation.
+
+        ``prefer`` names a candidate to try FIRST regardless of rank: the
+        elastic drain paths pass the seam checkpoint they just wrote, whose
+        phase-local step ordinal may sort below an older epoch-end save —
+        the ranked order remains the fallback if it fails verification."""
         with obs.span("ckpt.restore"):
-            for name in self._candidates():
+            cands = self._candidates()
+            if prefer is not None and prefer in cands:
+                cands = [prefer] + [c for c in cands if c != prefer]
+            for name in cands:
                 try:
                     state, infos = load_state(self.ckpt_dir, name, template)
                     # which candidate won matters to the caller (sidecar

@@ -293,6 +293,13 @@ class TrainConfig:
     # rendezvous the survivors, rebuild a shrunk data mesh, reshard from the
     # drained checkpoint, and continue with per-host batch rescaling
     elastic: str = "strict"
+    # grow-back direction (only meaningful with elastic="degraded"): a
+    # degraded run polls for generation-stamped rejoin markers at batch
+    # boundaries and re-admits a validated recovered host — drain, full-mesh
+    # rendezvous, reshard state from the SURVIVORS (never the rejoiner's
+    # stale checkpoint), continue the epoch remainder. False = a degraded
+    # run stays degraded (the pre-regrow ratchet-down behavior)
+    elastic_regrow: bool = True
     # a cross-host collective slower than this emits a dcn_stall event +
     # counter (the DCN-stall span around the multihost barrier/broadcast)
     dcn_stall_s: float = 2.0

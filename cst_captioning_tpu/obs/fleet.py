@@ -23,7 +23,10 @@ streams). :func:`merge_bundles` turns them into one verified forensic:
   a nonfinite/anomaly verdict; per-step cross-host lag names the straggler;
   ``dcn_stall`` / ``anomaly`` / drain events from each bundle's
   ``events_tail`` interleave at corrected times; ``lost`` /
-  ``victim_host`` meta from peer-loss and chaos bundles name the victim.
+  ``victim_host`` meta from peer-loss and chaos bundles name the victim;
+  ``mesh_shrink`` / ``mesh_regrow`` events pair into an **elastic**
+  section of shrink→regrow arcs, each naming its victim/rejoiner host
+  and generation span (``regrow_refused`` marks failed attempts).
 - **degrade** — a proc with no bundle at all (it died before its first
   dump, or its filesystem went with it) yields an explicit
   ``missing_procs`` entry; the survivors still merge.
@@ -54,6 +57,7 @@ _BUNDLE_RE = re.compile(r"^postmortem_\d+_.+")
 _FLEET_EVENTS = (
     "dcn_stall", "anomaly", "divergence", "preempt", "peer_loss_drain",
     "serving_drain", "postmortem",
+    "mesh_shrink", "mesh_regrow", "regrow_refused",
 )
 _MAX_FLEET_EVENTS = 200
 
@@ -445,11 +449,46 @@ def merge_bundles(run_dir: str) -> dict[str, Any]:
                 "event": ev["event"],
             }
             for k in ("kind", "op", "dur_s", "gap_s", "reason", "step",
-                      "phase", "value"):
+                      "phase", "value", "victim", "rejoiner", "generation",
+                      "devices"):
                 if k in ev:
                     out[k] = ev[k]
             events.append(out)
     events.sort(key=lambda e: e["t_s"])
+
+    # elastic timeline: pair every mesh_shrink (arc opens, names ONE
+    # victim) with the next regrow event naming the same host —
+    # mesh_regrow closes the arc (re-admitted), regrow_refused marks a
+    # failed attempt and the arc stays open. Computed over the FULL event
+    # stream before the tail cap so old arcs survive long runs.
+    elastic: list[dict] = []
+    open_arcs: dict[Any, dict] = {}
+    for ev in events:
+        kind = ev["event"]
+        if kind == "mesh_shrink":
+            arc = {
+                "host": ev.get("victim"),
+                "shrink_t_s": ev["t_s"],
+                "shrink_gen": ev.get("generation"),
+                "regrow_t_s": None,
+                "regrow_gen": None,
+                "outcome": "open",
+                "refused": 0,
+            }
+            elastic.append(arc)
+            if arc["host"] is not None:
+                open_arcs[arc["host"]] = arc
+        elif kind == "regrow_refused":
+            arc = open_arcs.get(ev.get("rejoiner"))
+            if arc is not None:
+                arc["refused"] += 1
+        elif kind == "mesh_regrow":
+            arc = open_arcs.pop(ev.get("rejoiner"), None)
+            if arc is not None:
+                arc["regrow_t_s"] = ev["t_s"]
+                arc["regrow_gen"] = ev.get("generation")
+                arc["outcome"] = "readmitted"
+    fleet["elastic"] = elastic
     fleet["events"] = events[-_MAX_FLEET_EVENTS:]
     return fleet
 
@@ -515,6 +554,23 @@ def render_fleet(fleet: dict[str, Any]) -> str:
             f"straggler: proc{st['proc']} ({st['host']})  mean lag "
             f"{st['mean_lag_s']:.3f}s  max {st['max_lag_s']:.3f}s"
         )
+    for arc in fleet.get("elastic", []):
+        refused = (
+            f", {arc['refused']} refused attempt(s)" if arc["refused"] else ""
+        )
+        if arc["outcome"] == "readmitted":
+            span = arc["regrow_t_s"] - arc["shrink_t_s"]
+            lines.append(
+                f"elastic: host {arc['host']} shrink t+"
+                f"{arc['shrink_t_s']:.3f}s --> regrow t+"
+                f"{arc['regrow_t_s']:.3f}s (degraded {span:.3f}s, gen "
+                f"{arc['shrink_gen']}->{arc['regrow_gen']}{refused})"
+            )
+        else:
+            lines.append(
+                f"elastic: host {arc['host']} shrink t+"
+                f"{arc['shrink_t_s']:.3f}s --> (never rejoined{refused})"
+            )
 
     steps = fleet.get("steps", [])
     if not steps:

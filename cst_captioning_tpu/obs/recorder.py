@@ -72,6 +72,8 @@ _PROBE_COUNTERS = (
     "rl.staleness.dropped", "rl.actor.preempted",
     "resilience.nan_skip", "resilience.rollback", "resilience.chaos_fault",
     "health.peer_lost",
+    "resilience.regrow.attempts", "resilience.regrow.admitted",
+    "resilience.regrow.refused",
 )
 
 _EVENTS_TAIL_LINES = 200

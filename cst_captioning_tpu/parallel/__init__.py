@@ -18,6 +18,7 @@ from cst_captioning_tpu.parallel.comms import (
 )
 from cst_captioning_tpu.parallel.submesh import (
     SubmeshPlan,
+    grow_actors,
     largest_divisor,
     plan_submesh,
     shared_plan,
@@ -38,6 +39,7 @@ __all__ = [
     "BucketPlan",
     "CommConfig",
     "SubmeshPlan",
+    "grow_actors",
     "largest_divisor",
     "ledger",
     "plan_submesh",

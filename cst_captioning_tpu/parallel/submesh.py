@@ -15,7 +15,8 @@ Two constraints shape the split:
 - each side's device count must divide the global batch (batch rows shard
   over the submesh axis), so counts are clamped DOWN to the largest divisor
   of the batch size — the same rule reclamps survivors after an
-  ``actor_preempt`` fault shrinks the actor side.
+  ``actor_preempt`` fault shrinks the actor side, and reclamps the grown
+  set when a ``host_rejoin`` re-admits a shed device (:func:`grow_actors`).
 
 Cross-submesh movement (finished rollouts actor->learner, fresh params
 learner->actor) is a plain ``jax.device_put`` onto the other submesh's
@@ -135,5 +136,52 @@ def shrink_actors(
         learner=plan.learner,
         actor_devices=survivors,
         learner_devices=plan.learner_devices,
+        shared=False,
+    )
+
+
+def grow_actors(
+    plan: SubmeshPlan | None,
+    device,
+    initial: SubmeshPlan,
+    axis: str = "data",
+    batch_size: int = 0,
+    dead=(),
+) -> SubmeshPlan | None:
+    """Re-admit one actor device (the inverse of :func:`shrink_actors`).
+
+    ``initial`` is the pristine pre-fault plan: membership AND order come
+    from it, so a shrink→grow round trip restores the exact original device
+    order — and with it the per-shard RNG folds, which is what makes
+    post-regrow rollouts bit-identical to a never-degraded run. ``dead``
+    names devices still known lost; everything else from the initial plan
+    is healthy and returns with the rejoiner (including devices the shrink
+    direction clamped away for batch divisibility — they were shed, not
+    preempted). ``plan`` is the current (possibly shrunk) plan, or ``None``
+    when the caller fell back to the sync schedule with no live actor. The
+    grown set reclamps to the largest batch divisor, like the shrink
+    direction. Returns ``None`` when the membership would not change
+    (shared initial plan, or a duplicate rejoin the clamp swallows);
+    raises if ``device`` was never part of the initial plan.
+    """
+    if initial.shared:
+        return None
+    if device not in initial.actor_devices:
+        raise ValueError(
+            f"grow_actors device {device} was never in the initial actor "
+            f"plan ({initial.actor_devices})"
+        )
+    current = set() if plan is None or plan.shared else set(plan.actor_devices)
+    gone = set(dead) - {device}
+    members = tuple(d for d in initial.actor_devices if d not in gone)
+    keep = largest_divisor(batch_size, len(members))
+    members = members[:keep]
+    if set(members) == current:
+        return None
+    return SubmeshPlan(
+        actor=_submesh(members, axis),
+        learner=initial.learner,
+        actor_devices=members,
+        learner_devices=initial.learner_devices,
         shared=False,
     )

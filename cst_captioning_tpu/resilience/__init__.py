@@ -22,13 +22,15 @@ makes each of them a *tested* code path:
   mid-epoch checkpoint recording the exact batch index, and exit cleanly.
 - :mod:`health`   — elastic multi-host layer: per-host heartbeats + a
   peer-loss watchdog (timeout/backoff), survivor rendezvous for the
-  degraded-mesh continuation, and the DCN-stall span around cross-host
-  collectives.
+  degraded-mesh continuation, the validated rejoin path that grows the
+  mesh back when a lost host recovers, and the DCN-stall span around
+  cross-host collectives.
 - :mod:`chaos`    — seeded fault plans (NaN-poisoned batches, kill-mid-save,
   transient I/O errors, slow/failing reward calls, preemption signals,
-  partial preemption of one host, slow/partial H2D transfers, wedged
-  prefetch threads, ENOSPC mid-rotation) driven by the tests through named
-  injection points compiled into the hot paths.
+  partial preemption of one host, host rejoin after recovery — including
+  the flaky rejoiner that dies mid-rendezvous — slow/partial H2D
+  transfers, wedged prefetch threads, ENOSPC mid-rotation) driven by the
+  tests through named injection points compiled into the hot paths.
 """
 
 from cst_captioning_tpu.resilience.chaos import (
@@ -39,10 +41,14 @@ from cst_captioning_tpu.resilience.chaos import (
 )
 from cst_captioning_tpu.resilience.health import (
     HealthMonitor,
+    HostRejoin,
     PeerLost,
+    RejoinRefused,
     RendezvousTimeout,
+    attempt_rejoin,
     collective_span,
     rendezvous,
+    simulate_rejoin,
 )
 from cst_captioning_tpu.resilience.durable import (
     CorruptCheckpointError,
@@ -64,19 +70,23 @@ __all__ = [
     "Fault",
     "FaultPlan",
     "HealthMonitor",
+    "HostRejoin",
     "PartialTransferError",
     "PeerLost",
     "Preempted",
     "PreemptionHandler",
+    "RejoinRefused",
     "RendezvousTimeout",
     "RetryPolicy",
     "RollbackRequested",
     "SimulatedKill",
     "TrainingDiverged",
+    "attempt_rejoin",
     "collective_span",
     "guarded_apply_gradients",
     "rendezvous",
     "retry_call",
+    "simulate_rejoin",
     "verify_manifest",
     "write_manifest",
 ]

@@ -46,6 +46,20 @@ Fault kinds:
   :class:`~cst_captioning_tpu.rl.async_scst.AsyncSCSTTrainer` epoch sheds
   the device, recounts the in-flight rollout ring on the survivors, and
   falls back to the sync schedule when no actor remains.
+- ``"host_rejoin"`` — the grow-back companion to ``partial_preempt``: a
+  previously-lost host recovers NOW. Fired at ``health.rejoin`` it acts on
+  the phantom's behalf via
+  :func:`~cst_captioning_tpu.resilience.health.simulate_rejoin` (tombstone
+  cleared, fresh heartbeat, generation-stamped rejoin marker, regrow
+  rendezvous check-in) and the degraded trainer re-admits it at the next
+  batch boundary. Fired at ``rl.actor.step`` it instead re-admits one
+  previously-shed actor device (``host`` indexes into the initial actor
+  plan) via
+  :func:`~cst_captioning_tpu.rl.async_scst.request_actor_rejoin`.
+- ``"host_rejoin_flaky"`` — the flaky rejoiner: the host announces itself
+  (marker + heartbeat land) and then dies mid-rendezvous, so the
+  survivors' regrow rendezvous times out and the run continues degraded —
+  a failed rejoin must never become a second outage.
 
 Injection points currently compiled in:
 
@@ -62,6 +76,7 @@ Injection points currently compiled in:
 ``reward.call``    inside the retried RL reward invocation
 ``serving.step``   serving admission loop, once per iteration (main thread)
 ``rl.actor.step``  decoupled RL actor loop, once per decoded batch
+``health.rejoin``  degraded trainer's rejoin poll, once per batch boundary
 =================  =========================================================
 """
 
@@ -102,7 +117,8 @@ class Fault:
     ``("rand", lo, hi)`` to have :class:`FaultPlan` draw it from the plan
     seed (deterministic per seed). ``times`` widens io_error/nan/slow faults
     to that many consecutive visits. ``host`` names the victim host of a
-    ``partial_preempt``.
+    ``partial_preempt`` — and, symmetrically, the rejoiner of a
+    ``host_rejoin``/``host_rejoin_flaky``.
     """
 
     point: str
@@ -114,7 +130,8 @@ class Fault:
 
     _KINDS = ("kill", "preempt", "io_error", "nan", "slow", "slow_h2d",
               "partial_h2d", "wedged_prefetch", "enospc_rotation",
-              "partial_preempt", "serving_preempt", "actor_preempt")
+              "partial_preempt", "serving_preempt", "actor_preempt",
+              "host_rejoin", "host_rejoin_flaky")
 
     def __post_init__(self):
         if self.kind not in self._KINDS:
@@ -189,10 +206,12 @@ class FaultPlan:
                 # merge can attribute a partial preemption to a named host
                 # (victim_host, not host — meta's `host` is the identity of
                 # the RECORDING process, set by the recorder itself)
-                extra = (
-                    {"victim_host": f.host}
-                    if f.kind == "partial_preempt" else {}
-                )
+                if f.kind == "partial_preempt":
+                    extra = {"victim_host": f.host}
+                elif f.kind in ("host_rejoin", "host_rejoin_flaky"):
+                    extra = {"rejoiner_host": f.host}
+                else:
+                    extra = {}
                 obs_recorder.note_fault(point, f.kind, visit=idx, **extra)
         # fire outside the lock: handlers/sleeps must not serialize threads
         for f in due:
@@ -229,6 +248,18 @@ class FaultPlan:
                 from cst_captioning_tpu.rl import async_scst
 
                 async_scst.request_actor_preempt(f.host)
+            elif f.kind in ("host_rejoin", "host_rejoin_flaky"):
+                if point == "rl.actor.step":
+                    # actor-fleet direction: re-admit a shed actor device
+                    from cst_captioning_tpu.rl import async_scst
+
+                    async_scst.request_actor_rejoin(f.host)
+                else:
+                    from cst_captioning_tpu.resilience import health
+
+                    health.simulate_rejoin(
+                        f.host, flaky=(f.kind == "host_rejoin_flaky")
+                    )
             elif f.kind in ("slow", "slow_h2d", "wedged_prefetch"):
                 time.sleep(f.delay)
             elif f.kind == "nan":

@@ -22,6 +22,20 @@ failure an *observable, recoverable* event:
   each writes a marker into a generation-numbered directory and polls (with
   exponential backoff) until every expected host checked in or the timeout
   expires. Deterministic and injectable (``clock``/``sleep``) for tests.
+- **Rejoin rendezvous (the grow-back direction)** — a recovered host
+  announces itself with a generation-stamped rejoin marker next to its
+  heartbeat (:meth:`HealthMonitor.announce_rejoin`); the surviving
+  coordinator validates liveness with ``misses`` consecutive fresh-heartbeat
+  reads (:meth:`HealthMonitor.validate_rejoin`, run under the budgeted
+  retry policy via :func:`attempt_rejoin`), bumps the mesh generation, and
+  re-admits the host (:meth:`HealthMonitor.readmit`). A refused or
+  timed-out rejoin raises :class:`RejoinRefused` and leaves the degraded
+  membership untouched — graceful degradation, never a second outage.
+
+All marker files here (heartbeats, tombstones, rejoin markers, rendezvous
+check-ins) are published tmp-then-rename and read torn-read-tolerantly: a
+poller racing a writer sees the previous marker or nothing, never a
+truncated file.
 - :func:`collective_span` — the DCN-stall probe: wraps a cross-host
   barrier/broadcast in an obs span and emits a ``dcn_stall`` event + counter
   when the collective exceeds the stall threshold, closing the "span around
@@ -57,6 +71,22 @@ class RendezvousTimeout(RuntimeError):
     """A degraded-mesh rendezvous expired before every survivor checked in."""
 
 
+class RejoinRefused(RuntimeError):
+    """A host's rejoin attempt was refused (marker absent or corrupt, stale
+    generation, no fresh heartbeats). The degraded run continues untouched."""
+
+
+class HostRejoin(RuntimeError):
+    """Raised by a train loop after a validated rejoin drained the pipeline
+    at a batch boundary. ``host`` names the rejoiner; the caller runs the
+    regrow rendezvous and rebuilds the full mesh — or, if that rendezvous
+    times out, keeps the degraded mesh and continues."""
+
+    def __init__(self, host: int, message: str):
+        self.host = int(host)
+        super().__init__(message)
+
+
 # default threshold for the DCN-stall probe; overridden per run from
 # train.dcn_stall_s via set_dcn_stall_threshold
 _DCN_STALL_S = 2.0
@@ -65,6 +95,16 @@ _DCN_STALL_S = 2.0
 def set_dcn_stall_threshold(seconds: float) -> None:
     global _DCN_STALL_S
     _DCN_STALL_S = float(seconds)
+
+
+def _publish_json(path: str, rec: dict) -> None:
+    """Atomic marker publish: write a sibling tmp file, then rename into
+    place. A reader polling mid-write sees the previous marker or nothing —
+    never a truncated/torn file."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)
 
 
 @contextmanager
@@ -137,6 +177,10 @@ class HealthMonitor:
         self._start_thread = start_thread
         self.peers: set[int] = set(range(num_hosts)) - {host_id}
         self.lost_hosts: set[int] = set()
+        # mesh generation: bumped by the trainer on every membership change
+        # (shrink or regrow); rejoin markers are stamped with generation+1
+        # so a marker from a previous regrow round is refused as stale
+        self.generation = 0
         self._seq = 0
         self._step = 0
         self._seen_seq: dict[int, int] = {}
@@ -213,12 +257,8 @@ class HealthMonitor:
             self._seq += 1
             rec = {"host": self.host_id, "seq": self._seq,
                    "step": self._step, "ts": time.time()}  # graftlint: disable=GL010 (heartbeat wall-clock payload, read by humans/other hosts)
-        path = self._hb_path(self.host_id)
-        tmp = f"{path}.tmp{os.getpid()}"
         try:
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(rec, f)
-            os.replace(tmp, path)
+            _publish_json(self._hb_path(self.host_id), rec)
         except OSError as e:
             # a missed beat is survivable (peers debounce); losing the run
             # to a transient shared-fs error is not
@@ -230,9 +270,10 @@ class HealthMonitor:
     def _read_hb(self, host: int) -> dict | None:
         try:
             with open(self._hb_path(host), encoding="utf-8") as f:
-                return json.load(f)
+                rec = json.load(f)
         except (OSError, ValueError):
             return None  # absent / torn mid-replace: treated as "no news"
+        return rec if isinstance(rec, dict) else None
 
     # ---- peer-loss detection ------------------------------------------------
 
@@ -311,9 +352,187 @@ class HealthMonitor:
                 f"partial_preempt host {host} not a peer of host "
                 f"{self.host_id} (peers: {sorted(self.peers)})"
             )
-        with open(self._tombstone(host), "w", encoding="utf-8") as f:
-            json.dump({"host": host, "by": self.host_id}, f)
+        try:
+            _publish_json(self._tombstone(host),
+                          {"host": host, "by": self.host_id})
+        except OSError as e:
+            # the synchronous mark below still lands; peers of a REAL fleet
+            # would fall back to heartbeat-timeout detection
+            self.log("tombstone_write_failed", host=host,
+                     error=type(e).__name__, detail=str(e))
         self._mark_lost(host, reason="partial_preempt")
+
+    def simulate_recovery(self, host: int, flaky: bool = False) -> None:
+        """Chaos hook (``host_rejoin`` fault): a lost — possibly simulated —
+        peer recovers NOW. Acts on the phantom's behalf, mirroring what a
+        really-restarted process does in :meth:`start` +
+        :meth:`announce_rejoin`: clear its tombstone, publish the recovered
+        incarnation's first heartbeat (a fresh seq stream), write a rejoin
+        marker stamped with the NEXT generation, and — unless ``flaky`` —
+        pre-check into the regrow rendezvous. A flaky rejoiner announces
+        itself and then dies mid-rendezvous: marker and heartbeat land, the
+        rendezvous check-in never does, so the survivors' regrow rendezvous
+        times out and the run continues degraded."""
+        if host == self.host_id:
+            raise ValueError(
+                f"host_rejoin host {host} is this host; it never left"
+            )
+        with self._lock:
+            if host not in self.lost_hosts:
+                raise ValueError(
+                    f"host_rejoin host {host} is not a lost host "
+                    f"(lost: {sorted(self.lost_hosts)})"
+                )
+            fresh_seq = int(self._seen_seq.get(host) or 0) + 1
+        try:
+            os.unlink(self._tombstone(host))
+        except FileNotFoundError:
+            pass
+        gen = int(self.generation) + 1
+        _publish_json(self._hb_path(host), {
+            "host": host, "seq": fresh_seq, "step": 0,
+            "ts": time.time(),  # graftlint: disable=GL010 (heartbeat wall-clock payload, read by humans/other hosts)
+        })
+        self.announce_rejoin(gen, host=host)
+        if not flaky:
+            _write_rendezvous_marker(self.dir, gen, host)
+        self.log("host_rejoin_simulated", host=host, generation=gen,
+                 flaky=flaky)
+
+    # ---- rejoin rendezvous (grow-back) --------------------------------------
+
+    def _rejoin_path(self, host: int) -> str:
+        return os.path.join(self.dir, f"host{host}.rejoin")
+
+    def announce_rejoin(self, generation: int, host: int | None = None) -> None:
+        """Publish a generation-stamped rejoin marker next to the heartbeat
+        (tmp-then-rename, like every marker here). A recovered host calls
+        this with the generation it wants to join — current + 1, learned
+        from the coordinator's latest rendezvous directory or config."""
+        host = self.host_id if host is None else int(host)
+        rec = {"host": host, "generation": int(generation),
+               "ts": time.time()}  # graftlint: disable=GL010 (rejoin marker wall-clock payload)
+        try:
+            _publish_json(self._rejoin_path(host), rec)
+        except OSError as e:
+            self.log("rejoin_write_failed", host=host,
+                     error=type(e).__name__, detail=str(e))
+            return
+        obs.counter("health.rejoin_announced").inc()
+
+    def read_rejoin(self, host: int) -> dict | None:
+        """Torn-read-tolerant rejoin marker read (absent/corrupt → None)."""
+        try:
+            with open(self._rejoin_path(host), encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return rec if isinstance(rec, dict) else None
+
+    def pending_rejoins(self) -> dict[int, dict]:
+        """Lost hosts that have published a readable rejoin marker, keyed by
+        host id. The train loops poll this at batch boundaries (one stat()
+        per lost host — only ever on an already-degraded run)."""
+        out: dict[int, dict] = {}
+        for h in self.lost():
+            rec = self.read_rejoin(h)
+            if rec is not None:
+                out[h] = rec
+        return out
+
+    def clear_rejoin(self, host: int) -> None:
+        """Consume a rejoin marker — after admission, or after a refusal so
+        the run does not re-litigate the same dead marker every batch."""
+        try:
+            os.unlink(self._rejoin_path(host))
+        except FileNotFoundError:
+            pass
+
+    def validate_rejoin(
+        self,
+        host: int,
+        generation: int,
+        sleep: Callable[[float], None] | None = None,
+    ) -> dict:
+        """Coordinator-side admission check for one announced rejoiner.
+
+        Read-only (membership is only mutated by :meth:`readmit`): the
+        rejoin marker must parse and carry exactly ``generation`` (a marker
+        from an earlier regrow round is stale — the host must re-announce),
+        and liveness is proven with ``misses`` consecutive heartbeat reads,
+        each of which must return a parseable heartbeat whose seq differs
+        from the last seq seen before the loss (a restarted process begins a
+        new seq stream; the dead incarnation's stale file never passes).
+        Pass ``sleep`` (spaced by ``interval_s``) when polling a real remote
+        host. Raises :class:`RejoinRefused`; returns the marker on success.
+        """
+        rec = self.read_rejoin(host)
+        if rec is None:
+            raise RejoinRefused(
+                f"host {host}: rejoin marker absent or unreadable"
+            )
+        marker_gen = rec.get("generation")
+        if marker_gen != int(generation):
+            raise RejoinRefused(
+                f"host {host}: stale rejoin generation {marker_gen!r} "
+                f"(current regrow generation is {int(generation)})"
+            )
+        with self._lock:
+            if host not in self.lost_hosts:
+                raise RejoinRefused(
+                    f"host {host} is not in the lost set "
+                    f"({sorted(self.lost_hosts)}); nothing to re-admit"
+                )
+            stale_seq = self._seen_seq.get(host)
+        for i in range(self.misses):
+            if i and sleep is not None:
+                sleep(self.interval_s)
+            hb = self._read_hb(host)
+            if hb is None:
+                raise RejoinRefused(
+                    f"host {host}: no readable heartbeat on poll "
+                    f"{i + 1}/{self.misses} — announced, then went silent"
+                )
+            if hb.get("seq") == stale_seq:
+                raise RejoinRefused(
+                    f"host {host}: heartbeat seq {stale_seq} predates the "
+                    f"loss (poll {i + 1}/{self.misses}) — the dead "
+                    "incarnation's file, not a recovery"
+                )
+        return rec
+
+    def readmit(self, host: int) -> None:
+        """Admit a validated rejoiner back into the membership (the inverse
+        of the loss mark): clear the lost record, re-arm liveness tracking
+        with a fresh grace stamp, and consume the tombstone + rejoin
+        marker + recovery heartbeat. Consuming the heartbeat returns the
+        host to the never-seen state — tombstone-only loss detection —
+        until its NEW incarnation's beat stream is observed, so a
+        simulated phantom that cannot keep beating is not immediately
+        re-declared lost by staleness (a real host re-publishes within one
+        beat interval and staleness protection resumes). Call only after
+        :meth:`validate_rejoin` (or :func:`attempt_rejoin`) and a
+        successful regrow rendezvous."""
+        now = self.clock()
+        with self._lock:
+            if host not in self.lost_hosts:
+                raise ValueError(
+                    f"host {host} is not lost; nothing to readmit"
+                )
+            self.lost_hosts.discard(host)
+            self.peers.add(host)
+            self._seen_seq.pop(host, None)
+            self._strikes[host] = 0
+            self._last_seen[host] = now
+        for path in (self._tombstone(host), self._rejoin_path(host),
+                     self._hb_path(host)):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+        obs.counter("health.peer_readmitted").inc()
+        obs.event("peer_readmitted", host=host)
+        self.log("peer_readmitted", host=host)
 
     # ---- membership ---------------------------------------------------------
 
@@ -335,7 +554,10 @@ class HealthMonitor:
 
     def acknowledge(self) -> None:
         """Clear the pending loss flag (the drain+continuation handled it);
-        the lost set stays recorded so a dead host is never re-admitted."""
+        the lost set stays recorded so a dead host is never re-admitted *by
+        accident* — re-admission happens only through the validated rejoin
+        path (:meth:`validate_rejoin` → regrow rendezvous →
+        :meth:`readmit`)."""
         self._loss_event.clear()
 
     def set_membership(self, hosts: Iterable[int]) -> None:
@@ -365,6 +587,73 @@ def simulate_peer_loss(host: int) -> None:
     mon.simulate_loss(host)
 
 
+def simulate_rejoin(host: int, flaky: bool = False) -> None:
+    """Module-level chaos entry point for the ``host_rejoin`` (and, with
+    ``flaky=True``, ``host_rejoin_flaky``) fault kinds."""
+    mon = _ACTIVE
+    if mon is None:
+        raise RuntimeError(
+            "host_rejoin fault fired with no active HealthMonitor — enable "
+            "train.health (the fault models a recovered host the monitor "
+            "must re-admit)"
+        )
+    mon.simulate_recovery(host, flaky=flaky)
+
+
+def attempt_rejoin(
+    monitor: HealthMonitor,
+    host: int,
+    generation: int,
+    policy=None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> dict:
+    """Validate one announced rejoiner under the budgeted-retry policy.
+
+    A refusal is often transient (the recovered host's first heartbeat may
+    land a beat after its marker), so validation retries under the same
+    seeded/budgeted backoff used for checkpoint I/O. Returns the validated
+    marker on success; once the policy's attempts or sleep budget are
+    exhausted the final :class:`RejoinRefused` propagates and the caller
+    keeps the degraded membership untouched — never a second outage.
+    Feeds ``resilience.regrow.{attempts,refused}``.
+    """
+    from cst_captioning_tpu.resilience.retry import RetryPolicy, retry_call
+
+    obs.counter("resilience.regrow.attempts").inc()
+    if policy is None:
+        policy = RetryPolicy(
+            max_attempts=2,
+            base_delay=monitor.interval_s,
+            max_delay=monitor.timeout_s,
+            budget=monitor.timeout_s,
+            retry_on=(RejoinRefused, OSError),
+        )
+
+    def on_retry(info: dict) -> None:
+        monitor.log("rejoin_retry", host=host, attempt=info["attempt"],
+                    delay=info["delay"], error=info["error"])
+
+    try:
+        return retry_call(monitor.validate_rejoin, host, generation,
+                          policy=policy, on_retry=on_retry, sleep=sleep)
+    except RejoinRefused:
+        obs.counter("resilience.regrow.refused").inc()
+        raise
+
+
+def _write_rendezvous_marker(dir: str, generation: int, host_id: int) -> str:
+    """Check one host into a generation directory (atomic publish). Returns
+    the directory path. Shared by :func:`rendezvous` and the ``host_rejoin``
+    chaos hook (which checks in on a recovered phantom's behalf)."""
+    rdir = os.path.join(dir, f"rendezvous_{int(generation):04d}")
+    os.makedirs(rdir, exist_ok=True)
+    _publish_json(
+        os.path.join(rdir, f"host{host_id}.json"),
+        {"host": host_id, "ts": time.time()},  # graftlint: disable=GL010 (rendezvous marker wall-clock payload)
+    )
+    return rdir
+
+
 def rendezvous(
     dir: str,
     host_id: int,
@@ -386,13 +675,7 @@ def rendezvous(
     caller's strict fallback: abort and full-restart).
     """
     expected = sorted(int(h) for h in hosts)
-    rdir = os.path.join(dir, f"rendezvous_{int(generation):04d}")
-    os.makedirs(rdir, exist_ok=True)
-    own = os.path.join(rdir, f"host{host_id}.json")
-    tmp = f"{own}.tmp{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump({"host": host_id, "ts": time.time()}, f)  # graftlint: disable=GL010 (rendezvous marker wall-clock payload)
-    os.replace(tmp, own)
+    rdir = _write_rendezvous_marker(dir, generation, host_id)
     t0 = clock()
     delay = poll_s
     while True:

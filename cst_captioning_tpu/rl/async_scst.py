@@ -36,7 +36,13 @@ Chaos story: the ``rl.actor.step`` injection point takes the
 device sheds it from the submesh plan, recounts the in-flight ring entries
 under the survivors, and re-broadcasts; when no actor survives (or the
 roles share one device), the epoch falls back to the sync schedule on the
-learner submesh. Drain: ``should_stop`` persists the in-flight ring as a
+learner submesh. The same point takes ``host_rejoin`` in the grow-back
+direction: a previously-shed device re-admits via
+:func:`~cst_captioning_tpu.parallel.submesh.grow_actors` (membership and
+order restored from the pristine initial plan), the ring re-binds to the
+grown submesh, and in-flight rollouts from the degraded period are drained
+and deterministically recounted in order — the same drop-and-recount
+spelling as the shrink. Drain: ``should_stop`` persists the in-flight ring as a
 ``seam.npz``-style blob (the trainer's ``_seam_bytes`` ring format) and a
 resume replays those exact tokens — strict-mode drains hold bit-identity
 (the depth-1 ring is empty between steps), decoupled drains are
@@ -60,6 +66,7 @@ from cst_captioning_tpu.config.config import RLConfig
 from cst_captioning_tpu.decoding import fused_decode, sample_decode
 from cst_captioning_tpu.parallel.submesh import (
     SubmeshPlan,
+    grow_actors,
     plan_submesh,
     shared_plan,
     shrink_actors,
@@ -73,6 +80,10 @@ from cst_captioning_tpu.train.state import TrainState
 # the epoch loop services them at the next rl.actor.step)
 _PREEMPT_REQUESTS: list[int] = []
 
+# pending actor-slice rejoins (chaos `host_rejoin` at rl.actor.step lands
+# here; the epoch loop services them at its next batch boundary)
+_REJOIN_REQUESTS: list[int] = []
+
 
 def request_actor_preempt(slice_index=None) -> None:
     """Mark one actor device (by index into the current actor submesh) as
@@ -80,6 +91,15 @@ def request_actor_preempt(slice_index=None) -> None:
     running :class:`AsyncSCSTTrainer` epoch services the request at its
     next ``rl.actor.step`` visit."""
     _PREEMPT_REQUESTS.append(0 if slice_index is None else int(slice_index))
+
+
+def request_actor_rejoin(slice_index=None) -> None:
+    """Inverse of :func:`request_actor_preempt`: re-admit one previously
+    shed actor device (by index into the INITIAL actor submesh — the
+    pristine plan, so a preempt/rejoin pair addressing the same index
+    round-trips the same device). Called by the chaos harness's
+    ``host_rejoin`` kind when fired at ``rl.actor.step``."""
+    _REJOIN_REQUESTS.append(0 if slice_index is None else int(slice_index))
 
 
 def make_actor_decode(model, mesh: Mesh | None, num_rollouts: int,
@@ -285,6 +305,10 @@ class AsyncSCSTTrainer(SCSTTrainer):
                 batch_size=batch_size,
             )
         self._plan = plan
+        # the pristine plan: grow-back restores membership/order from it,
+        # minus whatever the dead-actor ledger still names as lost
+        self._initial_plan = plan
+        self._dead_actors: set = set()
         lmesh = mesh if plan is None or plan.shared else plan.learner
         super().__init__(
             model, reward, cfg, mesh=lmesh, max_len=max_len, donate=donate,
@@ -307,6 +331,7 @@ class AsyncSCSTTrainer(SCSTTrainer):
         # per-epoch ledgers the bench and the recovery tests read back
         self.last_staleness: dict[int, int] = {}
         self.last_dropped = 0
+        self.last_rejoined = 0
         self.last_occupancy: dict[str, float] = {}
 
     # ---- submesh plumbing ---------------------------------------------------
@@ -374,6 +399,8 @@ class AsyncSCSTTrainer(SCSTTrainer):
             lost.extend(self._ring.drain_meta())
             new_plan = None
             if self._plan is not None and not self._plan.shared:
+                devs = self._plan.actor_devices
+                self._dead_actors.add(devs[idx % len(devs)])
                 new_plan = shrink_actors(
                     self._plan, idx, axis=self._axis,
                     batch_size=self._batch_size,
@@ -399,6 +426,49 @@ class AsyncSCSTTrainer(SCSTTrainer):
             # drained ring reallocates on the survivors' mesh at next push
             self._ring.mesh = self._actor_mesh
             self._actor_version = -1    # survivors need a fresh broadcast
+        return lost
+
+    def _service_rejoins(self) -> list[dict]:
+        """Apply pending ``host_rejoin`` requests: grow the actor submesh
+        back toward its initial plan (climbing out of the sync fallback if
+        that is where the shrinks left us), re-bind the ring to the grown
+        submesh, rebuild the actor decode, and return the orphaned ring
+        metadata so in-flight rollouts from the degraded period are
+        deterministically recounted in order — the shrink's drop-and-recount
+        spelling, run in the grow direction."""
+        lost: list[dict] = []
+        while _REJOIN_REQUESTS:
+            idx = _REJOIN_REQUESTS.pop(0)
+            obs.counter("rl.actor.rejoined").inc()
+            init = self._initial_plan
+            if init is None or init.shared:
+                continue    # nothing was ever split; nothing to grow
+            device = init.actor_devices[idx % len(init.actor_devices)]
+            self._dead_actors.discard(device)
+            new_plan = grow_actors(
+                None if self._fallback_sync else self._plan, device, init,
+                axis=self._axis, batch_size=self._batch_size,
+                dead=self._dead_actors,
+            )
+            if new_plan is None:
+                continue    # already present — a duplicate rejoin is a no-op
+            lost.extend(self._ring.drain_meta())
+            self._fallback_sync = False
+            self._plan = new_plan
+            self._actor_mesh = new_plan.actor
+            self.last_rejoined += 1
+            self.on_event(
+                "rl_actor_regrown", actors=new_plan.n_actors,
+                recount=len(lost),
+            )
+            self._actor_decode = make_actor_decode(
+                self.model, self._actor_mesh, self.cfg.num_rollouts,
+                self.cfg.temperature, self._max_len, axis=self._axis,
+                with_greedy=self._wg,
+            )
+            # drained ring reallocates on the grown mesh at next push
+            self._ring.mesh = self._actor_mesh
+            self._actor_version = -1    # the rejoiner needs the broadcast
         return lost
 
     # ---- drain-aware ring seam ---------------------------------------------
@@ -484,6 +554,7 @@ class AsyncSCSTTrainer(SCSTTrainer):
                 on_step(m)
 
         _PREEMPT_REQUESTS.clear()
+        _REJOIN_REQUESTS.clear()
         self._ring = RolloutRing(
             self._depth, mesh=self._actor_mesh, axis=self._axis
         )
@@ -492,6 +563,7 @@ class AsyncSCSTTrainer(SCSTTrainer):
         self._learner_version = 0
         self.last_staleness = {}
         self.last_dropped = 0
+        self.last_rejoined = 0
         replay: deque = deque(
             seam.get("ring", []) if seam else []
         )
@@ -573,7 +645,9 @@ class AsyncSCSTTrainer(SCSTTrainer):
                 break
             if not self._fallback_sync:
                 chaos.visit("rl.actor.step")
-            lost = self._service_preemptions()
+            # rejoins first: a rejoin+preempt landing on the same boundary
+            # grows then shrinks, in that deterministic order
+            lost = self._service_rejoins() + self._service_preemptions()
             if lost:
                 # recount the orphaned in-flight rollouts under whatever
                 # decodes now (survivor actors, or the learner submesh in

@@ -402,6 +402,54 @@ class CaptionService:
     def draining(self) -> bool:
         return self._drain.is_set()
 
+    def grow_capacity(self, new_capacity: int) -> None:
+        """Grow the lane pool at a stride seam (the elastic regrow
+        direction: a rejoined node re-admits a drained shard's work at
+        full width). Call between :meth:`serve` calls — never mid-stride.
+
+        Only grows: the free-slot list gains the new lane ids, the page
+        bank grows proportionally (``table_width`` pages per new lane, the
+        same per-lane share the constructor defaults to), the stride
+        closure rebuilds for the new ``B``, and — when lane state already
+        exists — every state leaf pads along the lane axis with lanes born
+        FINISHED and empty, exactly like :meth:`_ensure_state` births
+        them. Existing lanes' slots, pages, and in-flight decodes are
+        untouched, so growing mid-service never perturbs a running
+        request's stream. Shrinking is drain-and-rebuild, never in place.
+        """
+        new_b = int(new_capacity)
+        if new_b < self.B:
+            raise ValueError(
+                f"grow_capacity({new_capacity}) below current capacity "
+                f"{self.B} — the lane pool only grows (shrink = drain and "
+                "rebuild)"
+            )
+        if new_b == self.B:
+            return
+        old_b = self.B
+        grown = new_b - old_b
+        self.B = new_b
+        self._free_slots.extend(range(old_b, new_b))
+        self.bank.grow(self.bank.num_pages + grown * self.table_width)
+        self._stride_fn = self._build_stride_fn()
+        if self._state is not None:
+            carry, token, finished, t_local, keys = self._state
+
+            def pad(x, fill, axis):
+                widths = [(0, 0)] * x.ndim
+                widths[axis] = (0, grown)
+                return jnp.pad(x, widths, constant_values=fill)
+
+            self._state = (
+                tuple((pad(c, 0, 1), pad(h, 0, 1)) for c, h in carry),
+                pad(token, BOS_ID, 1),
+                pad(finished, True, 1),   # new lanes are born finished
+                pad(t_local, 0, 0),
+                pad(keys, 0, 0),
+            )
+        obs.counter("serving.lanes_regrown").inc(grown)
+        obs.event("serving_regrow", capacity=new_b, grown=grown)
+
     def set_slo(self, target_s: float) -> None:
         """(Re)arm the SLO monitor with a latency target — the bench calls
         this after calibrating a target from solo-request latency. Window
@@ -995,10 +1043,22 @@ class CaptionService:
         return snapshot_dir
 
 
-def load_snapshot(snapshot_dir: str) -> list[ClipRequest]:
+def load_snapshot(
+    snapshot_dir: str,
+    service: "CaptionService | None" = None,
+    grow_to: int | None = None,
+) -> list[ClipRequest]:
     """Drained queue -> requests, in the order the service would have run
     them. Re-serving them through a fresh CaptionService yields bit-identical
-    tokens (per-request determinism; in-flight requests restart from step 0)."""
+    tokens (per-request determinism; in-flight requests restart from step 0).
+
+    The regrow direction: pass ``service`` to replay the snapshot onto a
+    rejoined node — the drained requests resubmit in their drain order so
+    admissions resume where the outage cut them off. ``grow_to`` first
+    grows the service's lane pool to that capacity at a stride seam
+    (:meth:`CaptionService.grow_capacity`), covering the shard that rode
+    out the outage at reduced width. The bare one-argument call keeps the
+    old read-only contract and just returns the requests."""
     with open(os.path.join(snapshot_dir, "manifest.json"),
               encoding="utf-8") as f:
         manifest = json.load(f)
@@ -1011,6 +1071,16 @@ def load_snapshot(snapshot_dir: str) -> list[ClipRequest]:
             req_id=rec["req_id"], feats=feats, masks=masks,
             seed=int(rec["seed"]), arrival_s=float(rec["arrival_s"]),
         ))
+    if service is not None:
+        if grow_to is not None:
+            service.grow_capacity(grow_to)
+        for req in out:
+            service.submit(req)
+        obs.counter("serving.requests_replayed").inc(len(out))
+        obs.event(
+            "serving_replay", requests=len(out), capacity=service.B,
+            drain_reason=manifest.get("drain_reason", ""),
+        )
     return out
 
 

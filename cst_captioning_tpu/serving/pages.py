@@ -131,6 +131,34 @@ class PageBank:
             out[i, : len(pages)] = pages
         return out
 
+    def grow(self, num_pages: int) -> None:
+        """Grow the page pool in place (the elastic regrow direction: a
+        shard rebuilt at reduced width re-admits its drained work at full
+        capacity). Only grows — the free-list gains the new page ids and
+        the device pools (when already materialized) zero-pad along the
+        page axis, so existing page contents, the page table, and the
+        shared zero page are untouched. Shrinking is drain-and-rebuild,
+        never in place."""
+        new_n = int(num_pages)
+        if new_n < self.num_pages:
+            raise ValueError(
+                f"PageBank.grow({num_pages}) below current pool size "
+                f"{self.num_pages} — the pool only grows (shrink = drain "
+                "and rebuild)"
+            )
+        if new_n == self.num_pages:
+            return
+        extra = new_n - self.num_pages
+        self._free.extend(range(self.num_pages + 1, new_n + 1))
+        self.num_pages = new_n
+        if self.mem is not None:
+            def pad(x):
+                return jnp.pad(x, [(0, extra)] + [(0, 0)] * (x.ndim - 1))
+
+            self.mem = pad(self.mem)
+            self.proj = pad(self.proj)
+            self.mask = pad(self.mask)
+
     def snapshot(self) -> dict:
         """JSON-ready accounting snapshot (the drain persistence payload)."""
         return {

@@ -287,10 +287,24 @@ class Trainer:
             list(self.mesh.devices.flat) if self.mesh is not None else None
         )
         self._initial_hosts = 1
+        # pristine copies for the grow-back direction: _continue_degraded
+        # overwrites the two working attributes above at every shrink, but a
+        # regrow rebuilds host->device slices from the ORIGINAL layout
+        self._original_mesh_devices = (
+            None if self._all_mesh_devices is None
+            else list(self._all_mesh_devices)
+        )
+        self._original_hosts = 1
+        # a validated rejoiner awaiting admission at the next batch boundary
+        self._regrow_host: int | None = None
+        # name of the most recent step_* save — the drain seam the elastic
+        # continuations restore by name (see restore_latest(prefer=...))
+        self._last_step_ckpt: str | None = None
         if cfg.train.health:
             health_mod.set_dcn_stall_threshold(cfg.train.dcn_stall_s)
             num_hosts = cfg.train.health_sim_hosts or jax.process_count()
             self._initial_hosts = num_hosts
+            self._original_hosts = num_hosts
             self.health = health_mod.HealthMonitor(
                 cfg.train.health_dir
                 or os.path.join(cfg.train.ckpt_dir, "health"),
@@ -693,6 +707,9 @@ class Trainer:
                     self._ckpt_infos(phase, batch_index, step_no),
                     extra_files=extra,
                 )
+        # the elastic continuations restore THIS save by name: its
+        # phase-local step ordinal may rank below an older epoch-end ckpt
+        self._last_step_ckpt = f"step_{int(step_no):08d}"
         self.log.log(
             "ckpt_step", phase=phase, step=step_no, batch_index=batch_index,
             seam=bool(seam),
@@ -796,22 +813,25 @@ class Trainer:
 
     # ---- degraded-mesh continuation -----------------------------------------
 
-    def _surviving_devices(self, survivors: list[int]) -> list:
-        """Devices of the surviving hosts, in the original mesh order.
+    def _surviving_devices(self, survivors: list[int], devices=None,
+                           hosts: int | None = None) -> list:
+        """Devices of the given hosts, in the original mesh order.
 
         Real multi-process clusters map hosts to ``device.process_index``;
         simulated hosts (train.health_sim_hosts) split the mesh's device
-        list evenly — host k owns the k-th contiguous chunk."""
+        list evenly — host k owns the k-th contiguous chunk. The default
+        base is the CURRENT layout; the regrow path passes the pristine
+        ``_original_mesh_devices``/``_original_hosts`` so a re-admitted
+        host's slice comes back in its original position."""
+        devices = self._all_mesh_devices if devices is None else devices
+        hosts = self._initial_hosts if hosts is None else hosts
         if multihost.is_multiprocess():
             alive = set(survivors)
-            return [
-                d for d in self._all_mesh_devices
-                if d.process_index in alive
-            ]
-        per_host = max(1, len(self._all_mesh_devices) // self._initial_hosts)
+            return [d for d in devices if d.process_index in alive]
+        per_host = max(1, len(devices) // hosts)
         out = []
         for h in survivors:
-            out.extend(self._all_mesh_devices[h * per_host:(h + 1) * per_host])
+            out.extend(devices[h * per_host:(h + 1) * per_host])
         return out
 
     def _continue_degraded(self, phase: str, err: PeerLost) -> None:
@@ -861,8 +881,11 @@ class Trainer:
             self.batcher = self._rebuild_batcher(self.batcher, shard)
         # reshard params + optimizer state from the last durable checkpoint
         # onto the shrunk mesh (the peer-loss drain saved one moments ago,
-        # with the exact batch index + pipeline seam)
-        restored = self.ckpt.restore_latest(jax.device_get(self.state))
+        # with the exact batch index + pipeline seam — prefer it by NAME:
+        # its phase-local step ordinal may rank below an epoch-end save)
+        restored = self.ckpt.restore_latest(
+            jax.device_get(self.state), prefer=self._last_step_ckpt
+        )
         if restored is None:
             raise RuntimeError(
                 "degraded continuation found no restorable checkpoint in "
@@ -876,6 +899,9 @@ class Trainer:
         self._build_validator()
         self.health.set_membership(survivors)
         self.health.acknowledge()
+        # sync the monitor's generation: rejoin markers for the NEXT regrow
+        # round are stamped generation+1 (stale ones are refused)
+        self.health.generation = self._degraded_gen
         self._all_mesh_devices = devices
         self._initial_hosts = len(survivors)
         obs.counter("resilience.degraded_continuation").inc()
@@ -883,6 +909,13 @@ class Trainer:
             "degraded_mesh", phase=phase, lost=err.hosts,
             survivors=survivors, devices=n_data,
         )
+        # the elastic-timeline spelling (obs/fleet.py pairs shrink→regrow
+        # arcs): one event per victim so every arc names a single host
+        for victim in err.hosts:
+            obs.event(
+                "mesh_shrink", phase=phase, victim=victim, devices=n_data,
+                generation=self._degraded_gen,
+            )
         self.log.log(
             "degraded_mesh",
             phase=phase,
@@ -893,6 +926,175 @@ class Trainer:
             resumed_phase=res_phase,
             resumed_batch_index=batch_index,
         )
+
+    # ---- elastic grow-back (host re-admission) ------------------------------
+
+    def _poll_rejoin(self) -> None:
+        """Batch-boundary rejoin poll — the grow-back half of README
+        "Elastic training". Free unless the run is degraded with regrow
+        enabled (a couple of attribute reads); only then does it visit the
+        ``health.rejoin`` chaos point and scan for rejoin markers. A
+        readable marker triggers liveness validation under the budgeted
+        retry policy: success schedules admission at the next batch
+        boundary (``_regrow_host``), failure consumes the marker and leaves
+        the degraded run untouched."""
+        h = self.health
+        if (
+            h is None
+            or self._regrow_host is not None
+            or self.cfg.train.elastic != "degraded"
+            or not self.cfg.train.elastic_regrow
+            or self._original_mesh_devices is None
+            or not h.lost_hosts
+            or h.peer_lost  # an unacknowledged loss outranks a rejoin
+        ):
+            return
+        chaos.visit("health.rejoin")
+        pending = h.pending_rejoins()
+        if not pending:
+            return
+        host = min(pending)  # deterministic order when several announce
+        gen = self._degraded_gen + 1
+        try:
+            health_mod.attempt_rejoin(h, host, gen)
+        except health_mod.RejoinRefused as e:
+            h.clear_rejoin(host)
+            obs.event("rejoin_refused", host=host, generation=gen)
+            self.log.log(
+                "rejoin_refused", host=host, generation=gen, detail=str(e),
+            )
+            return
+        self._regrow_host = host
+
+    def _regrow_save(self, phase: str, step_no: int, batch_index: int,
+                     sentinel: DivergenceSentinel,
+                     seam: dict | None = None) -> None:
+        """A validated rejoiner is waiting: coordinated DRAIN at the batch
+        boundary — mirror of the peer-loss drain, seam included, so the
+        admission never tears a pipelined update — then :class:`HostRejoin`
+        unwinds to the phase loop, which runs the regrow rendezvous."""
+        host = self._regrow_host
+        sentinel.flush()
+        self._save_step_ckpt(phase, step_no, batch_index, seam=seam)
+        obs.counter("resilience.regrow_drain").inc()
+        self.log.log(
+            "regrow_drain", phase=phase, step=step_no,
+            batch_index=batch_index, rejoiner=host,
+        )
+        self.log.flush()
+        raise health_mod.HostRejoin(
+            host,
+            f"host {host} re-admission scheduled at {phase} step {step_no} "
+            f"(epoch {self.epoch + 1}, batch {batch_index}); drained and "
+            "saved",
+        )
+
+    def _continue_regrown(self, phase: str,
+                          err: health_mod.HostRejoin) -> bool:
+        """Elastic grow-back: the inverse of :meth:`_continue_degraded`.
+
+        Survivors and the rejoiner rendezvous at the bumped generation,
+        the FULL 1-D data mesh is rebuilt from the pristine device layout,
+        params + optimizer state reshard onto it via the ``replicate`` /
+        ``put_full_global`` path from the drain checkpoint the SURVIVORS
+        just wrote (the rejoiner never trusts its own stale checkpoint),
+        per-host batch shares rescale back (global batch unchanged), the
+        jitted closures rebuild, and the phase loop replays the epoch
+        remainder — seam included. Returns True on admission; False when
+        the rendezvous timed out or the grown mesh cannot carry the batch,
+        in which case the degraded run continues exactly where the drain
+        left it, untouched (never a second outage)."""
+        cfg = self.cfg
+        host = err.host
+        self._regrow_host = None
+        gen = self._degraded_gen + 1
+        members = sorted(set(self.health.survivors()) | {host})
+        devices = self._surviving_devices(
+            members, devices=self._original_mesh_devices,
+            hosts=self._original_hosts,
+        )
+        n_data = len(devices)
+        admitted = False
+        refuse_reason = ""
+        if cfg.data.batch_size % n_data:
+            refuse_reason = (
+                f"global batch_size {cfg.data.batch_size} is not divisible "
+                f"by the {n_data} regrown devices"
+            )
+        else:
+            try:
+                with obs.span("regrow_rendezvous", generation=gen):
+                    health_mod.rendezvous(
+                        self.health.dir,
+                        host_id=self.health.host_id,
+                        hosts=members,
+                        generation=gen,
+                        timeout_s=max(cfg.train.peer_timeout_s * 2.0, 0.5),
+                    )
+                admitted = True
+            except health_mod.RendezvousTimeout as e:
+                # the flaky rejoiner: announced, validated, then died
+                # before checking in — time out and stay degraded
+                refuse_reason = str(e)
+        if admitted:
+            self.mesh = Mesh(np.asarray(devices), ("data",))
+            if multihost.is_multiprocess():
+                shard = (members.index(jax.process_index()), len(members))
+                self.batcher = self._rebuild_batcher(self.batcher, shard)
+            self.health.readmit(host)
+            self.health.set_membership(members)
+            self._degraded_gen = gen
+            self.health.generation = gen
+            self._all_mesh_devices = devices
+            self._initial_hosts = len(members)
+        else:
+            obs.counter("resilience.regrow.refused").inc()
+            self.health.clear_rejoin(host)
+            obs.event(
+                "regrow_refused", phase=phase, rejoiner=host, generation=gen,
+            )
+            self.log.log(
+                "regrow_refused", phase=phase, rejoiner=host, generation=gen,
+                detail=refuse_reason,
+            )
+        # state from the SURVIVORS: the regrow drain saved the survivor
+        # state moments ago; restoring that checkpoint (by NAME — its
+        # phase-local step ordinal may rank below an epoch-end save) and
+        # replicating onto self.mesh (full when admitted, unchanged when
+        # refused) is the state handoff AND re-arms the mid-epoch resume
+        # bookkeeping (batch index + pipeline seam) either way
+        restored = self.ckpt.restore_latest(
+            jax.device_get(self.state), prefer=self._last_step_ckpt
+        )
+        if restored is None:
+            raise RuntimeError(
+                "regrow continuation found no restorable checkpoint in "
+                f"{cfg.train.ckpt_dir} — the regrow drain save is missing"
+            ) from err
+        state, infos = restored
+        batch_index, res_phase = self._adopt_restored(
+            state, infos, cfg.train.ckpt_dir
+        )
+        if admitted:
+            self._build_xe_step()
+            self._build_validator()
+            obs.counter("resilience.regrow.admitted").inc()
+            obs.event(
+                "mesh_regrow", phase=phase, rejoiner=host, devices=n_data,
+                generation=gen,
+            )
+            self.log.log(
+                "mesh_regrow",
+                phase=phase,
+                rejoiner=host,
+                hosts=members,
+                devices=n_data,
+                generation=gen,
+                global_batch=cfg.data.batch_size,
+                resumed_phase=res_phase,
+                resumed_batch_index=batch_index,
+            )
+        return admitted
 
     def _rebuild_batcher(self, old: Batcher, host_shard: tuple[int, int]) -> Batcher:
         """Same data order, new host share (degraded multi-process only)."""
@@ -951,6 +1153,9 @@ class Trainer:
                         raise
                     self._continue_degraded("xe", e)
                     run["first_step"] = True  # recompile on the shrunk mesh
+                except health_mod.HostRejoin as e:
+                    if self._continue_regrown("xe", e):
+                        run["first_step"] = True  # recompile on the full mesh
         return last_val
 
     def _xe_epoch(self, meter, profiler, sentinel, pre, run) -> float | None:
@@ -1041,6 +1246,9 @@ class Trainer:
                             self._peer_loss_save(
                                 "xe", step_no, batch_no, sentinel
                             )
+                        self._poll_rejoin()
+                        if self._regrow_host is not None:
+                            self._regrow_save("xe", step_no, batch_no, sentinel)
                         if ckpt_every and step_no % ckpt_every == 0:
                             # never save an update the policy rejects
                             flight.flush()
@@ -1055,6 +1263,9 @@ class Trainer:
                 self._preempt_save("xe", step_no, batch_no, sentinel)
             if self.health is not None and self.health.peer_lost:
                 self._peer_loss_save("xe", step_no, batch_no, sentinel)
+            self._poll_rejoin()
+            if self._regrow_host is not None:
+                self._regrow_save("xe", step_no, batch_no, sentinel)
             flight.flush()
             sentinel.flush()
         self.epoch += 1
@@ -1201,6 +1412,12 @@ class Trainer:
                         scst, rl_batcher = build_scst()
                         self._rl_batcher = rl_batcher
                         run["first_step"] = True
+                    except health_mod.HostRejoin as e:
+                        if self._continue_regrown("rl", e):
+                            # rebuild mesh-shaped closures on the FULL mesh
+                            scst, rl_batcher = build_scst()
+                            self._rl_batcher = rl_batcher
+                            run["first_step"] = True
         finally:
             self._rl_batcher = None
         return last_val
@@ -1292,6 +1509,7 @@ class Trainer:
             chaos.visit("rl.step")
             if self.health is not None:
                 self.health.note_step(step_counter["step"])
+            self._poll_rejoin()
 
         # pipelined epoch (rl.pipelined, default): host reward for batch i
         # overlaps device update i-1 + decode i+1; batches are prefetched
@@ -1317,7 +1535,7 @@ class Trainer:
                     pipelined=cfg.rl.pipelined,
                     should_stop=lambda: pre.requested or (
                         self.health is not None and self.health.peer_lost
-                    ),
+                    ) or self._regrow_host is not None,
                     seam=seam,
                     seam_sink=seam_sink if seam_capable else None,
                 )
@@ -1331,6 +1549,11 @@ class Trainer:
                 )
             if self.health is not None and self.health.peer_lost:
                 self._peer_loss_save(
+                    "rl", step_counter["step"], batch_counter["n"], sentinel,
+                    seam=seam_sink or None,
+                )
+            if self._regrow_host is not None:
+                self._regrow_save(
                     "rl", step_counter["step"], batch_counter["n"], sentinel,
                     seam=seam_sink or None,
                 )

@@ -1,0 +1,143 @@
+"""Elastic shrink->regrow chaos smoke: the lint-gate resilience check.
+
+Seeded end-to-end scenario on 2 simulated hosts with tiny dims (CPU,
+~half a minute): kill host 1 mid-RL-epoch (``partial_preempt``), let the
+survivor drain to a degraded 1-device mesh, then re-admit the recovered
+host through the ``health.rejoin`` marker seam (``host_rejoin``) and
+finish the budget on the FULL mesh. Asserts the trajectory invariants
+the chaos tests pin in depth:
+
+- both faults fired, in order;
+- the run ends on the full 2-device mesh (regrow admitted, none refused);
+- the step clock is contiguous through BOTH seams (no rewind, no skip);
+- rewards, losses, and final params are finite.
+
+Run by scripts/lint.sh (JAX_PLATFORMS=cpu). Exits non-zero on any
+violated invariant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the 2-simulated-host mesh needs devices: force 8 fake CPU devices
+# BEFORE jax's backend initializes (no-op for the TPU backend)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from cst_captioning_tpu.config.config import (  # noqa: E402
+    DataConfig,
+    EvalConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    RLConfig,
+    TrainConfig,
+)
+from cst_captioning_tpu.data import (  # noqa: E402
+    CaptionDataset,
+    make_synthetic_dataset,
+)
+from cst_captioning_tpu.resilience import Fault, FaultPlan  # noqa: E402
+from cst_captioning_tpu.train.trainer import Trainer  # noqa: E402
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as root:
+        synth = make_synthetic_dataset(
+            os.path.join(root, "synth"),
+            num_videos=12,
+            num_topics=3,
+            vocab_words=20,
+            modalities={"resnet": 16},
+            max_frames=4,
+            seed=5,
+        )
+        train_ds = CaptionDataset(
+            synth["info_json"], {"resnet": synth["resnet"]}, "train", 4
+        )
+        ckpt_dir = os.path.join(root, "run")
+        cfg = ExperimentConfig(
+            name="chaos_smoke",
+            model=ModelConfig(
+                vocab_size=len(train_ds.vocab),
+                modalities=(("resnet", 16),),
+                d_embed=16,
+                d_hidden=16,
+                d_att=8,
+                encoder="temporal_attention",
+                dropout=0.0,
+                max_len=8,
+                max_frames=4,
+                dtype="float32",
+            ),
+            data=DataConfig(batch_size=2, seq_per_vid=1),
+            train=TrainConfig(
+                lr=5e-3, grad_clip=5.0, ckpt_dir=ckpt_dir, seed=0,
+                log_every_steps=1, eval_every_epochs=100, epochs=1,
+                health=True, health_sim_hosts=2, elastic="degraded",
+            ),
+            rl=RLConfig(
+                enabled=True, num_rollouts=2, lr=1e-3, epochs=2,
+                baseline="greedy", pipelined=True,
+            ),
+            eval=EvalConfig(beam_size=1, max_len=8),
+            mesh=MeshConfig(num_devices=2),
+        )
+        log_path = os.path.join(root, "ev.jsonl")
+        tr = Trainer(cfg, train_ds, None, log_path=log_path)
+        try:
+            tr.train_xe()
+            plan = FaultPlan([
+                Fault("rl.step", "partial_preempt", at=0, host=1),
+                Fault("health.rejoin", "host_rejoin", at=0, host=1),
+            ])
+            with plan.activate():
+                tr.train_rl()
+
+            fired = [f["kind"] for f in plan.fired]
+            assert fired == ["partial_preempt", "host_rejoin"], fired
+            assert tr.mesh is not None and tr.mesh.devices.size == 2, (
+                "run did not finish on the full mesh"
+            )
+            events = [json.loads(line) for line in open(log_path)]
+
+            def of(kind):
+                return [e for e in events if e["event"] == kind]
+
+            assert of("mesh_regrow"), "no mesh_regrow event"
+            assert not of("regrow_refused"), of("regrow_refused")
+            steps = sorted({e["step"] for e in of("rl_step")})
+            assert steps == list(range(1, steps[-1] + 1)), (
+                f"step clock not contiguous through the seams: {steps}"
+            )
+            rewards = [e["reward"] for e in of("rl_step")]
+            losses = [e["rl_loss"] for e in of("rl_step")]
+            assert np.isfinite(rewards).all(), rewards
+            assert np.isfinite(losses).all(), losses
+            for leaf in jax.tree_util.tree_leaves(tr.state.params):
+                assert np.isfinite(np.asarray(leaf)).all(), "non-finite params"
+        finally:
+            tr.close()
+    print(
+        "chaos smoke OK: shrink->regrow finished on the full mesh, "
+        f"{len(steps)} contiguous RL steps, finite dynamics"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

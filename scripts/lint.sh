@@ -95,6 +95,12 @@ JAX_PLATFORMS=cpu python bench_rl_async.py --smoke > /dev/null
 # monotone vs greedy) — README "Eval fast path"
 JAX_PLATFORMS=cpu python bench_eval.py --smoke > /dev/null
 
+# elastic chaos smoke: seeded shrink->regrow scenario on 2 simulated
+# hosts — kill host 1 mid-RL-epoch, re-admit it through the rejoin
+# marker seam, finish on the FULL mesh with a contiguous step clock and
+# finite dynamics (README "Elastic training", grow-back half)
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py > /dev/null
+
 # runtime sanitizer smoke: the hot-path tier-1 subset under
 # jax.transfer_guard("disallow") + jax.debug_nans — the empirical half of
 # GL001/GL013's zero-implicit-transfer claim (README "Static analysis")

@@ -10,6 +10,7 @@ import pytest
 from cst_captioning_tpu.config.config import ModelConfig, RLConfig, TrainConfig
 from cst_captioning_tpu.models import CaptionModel
 from cst_captioning_tpu.parallel import (
+    grow_actors,
     largest_divisor,
     plan_submesh,
     shared_plan,
@@ -133,6 +134,36 @@ def test_shrink_actors_reclamps_and_exhausts():
         plan = smaller
     # the last actor cannot be shed: the caller falls back to sync
     assert shrink_actors(plan, 0, batch_size=8) is None
+
+
+def test_grow_actors_round_trip_restores_initial_plan():
+    mesh = make_mesh()
+    initial = plan_submesh(mesh, 0.5, batch_size=8)
+    victim = initial.actor_devices[0]
+    shrunk = shrink_actors(initial, 0, batch_size=8)
+    assert victim not in shrunk.actor_devices
+    # one rejoin restores every healthy device, including any the shrink
+    # clamped away for batch divisibility — in the original order
+    grown = grow_actors(shrunk, victim, initial, batch_size=8, dead=set())
+    assert grown is not None
+    assert grown.actor_devices == initial.actor_devices
+    assert grown.learner_devices == initial.learner_devices
+    # a duplicate rejoin changes nothing
+    assert grow_actors(grown, victim, initial, batch_size=8, dead=set()) is None
+    # still-dead peers stay out of the grown membership
+    others = [d for d in initial.actor_devices if d != victim]
+    if others:
+        partial = grow_actors(
+            shrunk, victim, initial, batch_size=8, dead={others[0]},
+        )
+        assert partial is None or others[0] not in partial.actor_devices
+    # a device that never belonged to the actor side is refused
+    with pytest.raises(ValueError):
+        grow_actors(shrunk, initial.learner_devices[0], initial, batch_size=8)
+    # growing out of the sync fallback (no surviving plan) also works
+    from_fallback = grow_actors(None, victim, initial, batch_size=8)
+    assert from_fallback is not None
+    assert from_fallback.actor_devices == initial.actor_devices
 
 
 # ---- strict-mode bit-identity ----------------------------------------------
@@ -417,6 +448,60 @@ def test_actor_preempt_exhaustion_falls_back_to_sync(model_setup):
     assert any(e == "rl_actor_fallback_sync" for e, _ in events)
     # metrics stay finite through the degradation chain
     assert all(np.isfinite(float(x["rl_loss"])) for x in m)
+
+
+@pytest.mark.slow
+def test_actor_preempt_then_rejoin_is_deterministic(model_setup):
+    """actor_preempt followed by host_rejoin shrinks then regrows the
+    actor fleet mid-epoch; in-flight rollouts orphaned at the grow
+    boundary are recounted in order, and two seeded runs produce
+    identical staleness histograms, token rows, losses, and params."""
+    from cst_captioning_tpu.resilience.chaos import Fault, FaultPlan
+
+    model, state, feats, masks = model_setup
+    mesh = make_mesh()
+    cfg = RLConfig(enabled=True, num_rollouts=2, baseline="greedy",
+                   rollout_depth=2, staleness_bound=1)
+    state_m = replicate(mesh, state)
+    f_s, m_s = shard_batch(mesh, (feats, masks))
+    batches = [(f_s, m_s, VIDS, None)] * 6
+
+    runs = []
+    for _ in range(2):
+        events = []
+        reward = TokenReward(7)
+        a = AsyncSCSTTrainer(model, reward, cfg, mesh=mesh, batch_size=B,
+                             on_event=lambda e, **kw: events.append((e, kw)))
+        n_actors = a._plan.n_actors
+        plan = FaultPlan([
+            Fault("rl.actor.step", "actor_preempt", at=1),
+            Fault("rl.actor.step", "host_rejoin", at=3),
+        ], seed=0)
+        with plan.activate():
+            s, m = a.train_epoch(state_m, iter(batches), jax.random.key(9))
+        assert len(m) == 6  # every batch still got exactly one update
+        assert [f["kind"] for f in plan.fired] == [
+            "actor_preempt", "host_rejoin",
+        ]
+        degraded = [kw for e, kw in events if e == "rl_actor_degraded"]
+        regrown = [kw for e, kw in events if e == "rl_actor_regrown"]
+        assert degraded and degraded[0]["survivors"] < n_actors
+        assert regrown and regrown[0]["actors"] == n_actors
+        assert a.last_rejoined == 1
+        assert a._plan.n_actors == n_actors
+        assert not a._fallback_sync
+        runs.append((
+            dict(a.last_staleness),
+            [c.copy() for c in reward.calls],
+            [float(x["rl_loss"]) for x in m],
+            s.params,
+        ))
+    assert runs[0][0] == runs[1][0]  # identical staleness histograms
+    assert len(runs[0][1]) == len(runs[1][1])
+    for r0, r1 in zip(runs[0][1], runs[1][1]):
+        np.testing.assert_array_equal(r0, r1)  # identical token rows
+    assert runs[0][2] == runs[1][2]
+    _assert_tree_equal(runs[0][3], runs[1][3])
 
 
 # ---- trainer seam serialization --------------------------------------------

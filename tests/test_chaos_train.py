@@ -508,6 +508,133 @@ def test_partial_preempt_degraded_mesh_continuation(datasets,
         tr.close()
 
 
+def test_partial_preempt_then_rejoin_regrows_full_mesh(datasets,
+                                                       tmp_path_factory):
+    """ISSUE 17 acceptance (grow-back half): after the degraded-mesh
+    continuation, the lost host announces recovery, is validated, and is
+    re-admitted at the next batch boundary — drain -> durable save -> full
+    rendezvous -> rebuilt 2-device mesh with the rejoiner's state
+    replicated from the survivors' drained checkpoint (never its stale
+    one). The run finishes its full budget on the FULL mesh with a
+    contiguous step clock, and the post-regrow step program is
+    bit-identical to a never-degraded trainer resumed from the same
+    checkpoint (one-epoch params + opt_state comparison)."""
+    train_ds, _ = datasets
+    d = str(tmp_path_factory.mktemp("regrown"))
+    cfg = make_cfg(d, len(train_ds.vocab), pipelined=True, batch_size=2,
+                   seq_per_vid=1, epochs=1, num_devices=2, health=True,
+                   health_sim_hosts=2, elastic="degraded")
+    tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl")
+    try:
+        tr.train_xe()
+        # visit 0 of rl.step = the very first RL step, so the pipelined
+        # drain lands mid-epoch (seam) and most of the budget runs AFTER
+        # the regrow; visit 0 of health.rejoin = the first poll after the
+        # degraded continuation announces host 1's recovery
+        plan = FaultPlan([
+            Fault("rl.step", "partial_preempt", at=0, host=1),
+            Fault("health.rejoin", "host_rejoin", at=0, host=1),
+        ])
+        with plan.activate():
+            tr.train_rl()  # shrinks, then regrows, inside
+        assert [f["kind"] for f in plan.fired] == [
+            "partial_preempt", "host_rejoin",
+        ]
+
+        # the run finished its full budget back on the FULL mesh
+        assert tr.rl_epochs == 2
+        assert tr.mesh is not None and tr.mesh.devices.size == 2
+        assert tr.health.survivors() == [0, 1]
+        assert tr.health.generation == 2  # shrink bumped to 1, regrow to 2
+
+        (deg,) = events_of(d + "/ev.jsonl", "degraded_mesh")
+        assert deg["lost"] == [1]
+        (rd,) = events_of(d + "/ev.jsonl", "regrow_drain")
+        assert rd["phase"] == "rl" and rd["rejoiner"] == 1
+        (rg,) = events_of(d + "/ev.jsonl", "mesh_regrow")
+        assert rg["rejoiner"] == 1 and rg["devices"] == 2
+        assert rg["hosts"] == [0, 1] and rg["generation"] == 2
+        assert not events_of(d + "/ev.jsonl", "regrow_refused")
+
+        # trajectory: every epoch reports, the step clock never rewinds or
+        # skips through shrink OR regrow, dynamics stay finite
+        rl_eps = events_of(d + "/ev.jsonl", "rl_epoch")
+        assert [e["epoch"] for e in rl_eps] == [2, 3]
+        steps = [e["step"] for e in events_of(d + "/ev.jsonl", "rl_step")]
+        assert sorted(set(steps)) == list(range(1, 11))
+        rewards = [
+            e["reward"] for e in events_of(d + "/ev.jsonl", "rl_step")
+        ]
+        assert np.isfinite(rewards).all()
+        for leaf in jax.tree_util.tree_leaves(tr.state.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+        # program-identity pin: a fresh never-degraded trainer resumed from
+        # the same checkpoint runs one more epoch bit-identically to the
+        # regrown in-memory trainer (params AND opt_state)
+        cfg2 = make_cfg(d, len(train_ds.vocab), pipelined=True, batch_size=2,
+                        seq_per_vid=1, epochs=1, num_devices=2, resume="auto")
+        tr2 = Trainer(cfg2, train_ds, None, log_path=d + "/ev2.jsonl")
+        assert tr2.epoch == tr.epoch
+        assert int(tr2.state.step) == int(tr.state.step)
+        params_equal(tr.state.params, tr2.state.params)
+        tr.train_rl(epochs=1)
+        tr2.train_rl(epochs=1)
+        params_equal(tr.state.params, tr2.state.params)
+        params_equal(tr.state.opt_state, tr2.state.opt_state)
+    finally:
+        tr.close()
+
+
+def test_flaky_rejoin_leaves_degraded_run_unharmed(datasets,
+                                                   tmp_path_factory):
+    """A rejoiner that announces recovery and then dies mid-rendezvous
+    (``host_rejoin_flaky``) must not damage the degraded run: the
+    survivors time out the regrow rendezvous, refuse the admission, and
+    continue on the shrunk mesh with params bit-identical to a run where
+    no rejoin was ever attempted."""
+    train_ds, _ = datasets
+
+    def run(d, extra_faults):
+        cfg = make_cfg(d, len(train_ds.vocab), pipelined=True, batch_size=2,
+                       seq_per_vid=1, epochs=1, num_devices=2, health=True,
+                       health_sim_hosts=2, elastic="degraded",
+                       peer_timeout_s=0.2)  # fast rendezvous timeout
+        tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl")
+        try:
+            tr.train_xe()
+            plan = FaultPlan(
+                [Fault("rl.step", "partial_preempt", at=0, host=1)]
+                + extra_faults
+            )
+            with plan.activate():
+                tr.train_rl()
+            assert tr.rl_epochs == 2
+            return tr, jax.device_get(tr.state.params)
+        finally:
+            tr.close()
+
+    d_plain = str(tmp_path_factory.mktemp("norejoins"))
+    d_flaky = str(tmp_path_factory.mktemp("flakyrejoin"))
+    _, params_plain = run(d_plain, [])
+    tr_b, params_flaky = run(d_flaky, [
+        Fault("health.rejoin", "host_rejoin_flaky", at=0, host=1),
+    ])
+
+    # the flaky run drained for the admission, timed out, refused it, and
+    # stayed degraded for its whole remaining budget
+    assert events_of(d_flaky + "/ev.jsonl", "regrow_drain")
+    (ref,) = events_of(d_flaky + "/ev.jsonl", "regrow_refused")
+    assert ref["rejoiner"] == 1
+    assert not events_of(d_flaky + "/ev.jsonl", "mesh_regrow")
+    assert tr_b.mesh is not None and tr_b.mesh.devices.size == 1
+    assert tr_b.health.survivors() == [0]
+    steps = [e["step"] for e in events_of(d_flaky + "/ev.jsonl", "rl_step")]
+    assert sorted(set(steps)) == list(range(1, 11))
+    # the failed admission left the trajectory untouched
+    params_equal(params_plain, params_flaky)
+
+
 def test_enospc_during_training_rotation_recovers(datasets, tmp_path_factory):
     """ENOSPC mid-run: the step-interval save reclaims the oldest step_*
     generation, retries, and training never notices."""
@@ -602,6 +729,45 @@ def test_decoupled_actor_preempt_degrades_to_survivors(datasets,
         assert tr.rl_epochs == 2
         (deg,) = events_of(d + "/ev.jsonl", "rl_actor_degraded")
         assert deg["survivors"] == 1
+        assert not events_of(d + "/ev.jsonl", "rl_actor_fallback_sync")
+        rewards = [
+            e["reward"] for e in events_of(d + "/ev.jsonl", "rl_step")
+        ]
+        assert rewards and np.isfinite(rewards).all()
+        for leaf in jax.tree_util.tree_leaves(tr.state.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+    finally:
+        tr.close()
+
+
+@pytest.mark.slow
+def test_decoupled_actor_rejoin_regrows_fleet(datasets, tmp_path_factory):
+    """ISSUE 17 actor-fleet arc: an ``actor_preempt`` sheds one actor, a
+    later ``host_rejoin`` re-admits it — the rollout ring re-binds to the
+    grown submesh, orphaned in-flight rollouts are recounted in order, and
+    every epoch completes with finite dynamics on the restored fleet."""
+    train_ds, _ = datasets
+    d = str(tmp_path_factory.mktemp("actorregrow"))
+    # 4 devices -> 2 actors / 2 learners; preempt actor 0, then rejoin it
+    cfg = make_cfg(d, len(train_ds.vocab), num_devices=4,
+                   rl_topology="decoupled")
+    tr = Trainer(cfg, train_ds, None, log_path=d + "/ev.jsonl")
+    try:
+        tr.train_xe()
+        plan = FaultPlan([
+            Fault("rl.actor.step", "actor_preempt", at=1),
+            Fault("rl.actor.step", "host_rejoin", at=3),
+        ])
+        with plan.activate():
+            tr.train_rl()
+        assert [f["kind"] for f in plan.fired] == [
+            "actor_preempt", "host_rejoin",
+        ]
+        assert tr.rl_epochs == 2
+        (deg,) = events_of(d + "/ev.jsonl", "rl_actor_degraded")
+        assert deg["survivors"] == 1
+        regrown = events_of(d + "/ev.jsonl", "rl_actor_regrown")
+        assert regrown and regrown[0]["actors"] == 2  # the initial fleet
         assert not events_of(d + "/ev.jsonl", "rl_actor_fallback_sync")
         rewards = [
             e["reward"] for e in events_of(d + "/ev.jsonl", "rl_step")

@@ -730,6 +730,192 @@ def test_rendezvous_backoff_grows_poll_interval(tmp_path):
     assert max(sleeps) <= 0.5 + 1e-9  # capped
 
 
+# ---- health.py: rejoin rendezvous (grow-back) --------------------------------
+
+def test_health_rejoin_validated_readmission_round_trip(tmp_path):
+    """Loss -> recovery -> validate -> readmit restores the membership and
+    consumes every marker (tombstone + rejoin)."""
+    from cst_captioning_tpu.resilience.health import HealthMonitor
+
+    mon = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2, misses=2,
+                        start_thread=False).start()
+    try:
+        mon.simulate_loss(1)
+        mon.acknowledge()
+        assert mon.survivors() == [0]
+        before = _counter("health.peer_readmitted")
+        mon.simulate_recovery(1)
+        # announced: rejoin marker + fresh heartbeat, tombstone consumed
+        assert os.path.exists(str(tmp_path / "host1.rejoin"))
+        assert not os.path.exists(str(tmp_path / "host1.dead"))
+        assert list(mon.pending_rejoins()) == [1]
+        marker = mon.validate_rejoin(1, mon.generation + 1)
+        assert marker["host"] == 1
+        mon.readmit(1)
+        assert mon.survivors() == [0, 1] and mon.lost() == []
+        assert not os.path.exists(str(tmp_path / "host1.rejoin"))
+        assert _counter("health.peer_readmitted") == before + 1
+        with pytest.raises(ValueError):
+            mon.readmit(1)  # no longer lost: nothing to readmit
+    finally:
+        mon.stop()
+
+
+def test_health_rejoin_stale_generation_refused(tmp_path):
+    """A marker from an earlier regrow round never admits: the host must
+    re-announce at the current generation."""
+    from cst_captioning_tpu.resilience.health import (
+        HealthMonitor,
+        RejoinRefused,
+    )
+
+    mon = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2, misses=1,
+                        start_thread=False).start()
+    try:
+        mon.simulate_loss(1)
+        mon.acknowledge()
+        mon.announce_rejoin(1, host=1)  # an old round's marker
+        with pytest.raises(RejoinRefused, match="stale rejoin generation"):
+            mon.validate_rejoin(1, 2)
+        # right generation but no recovered heartbeat: still refused
+        mon.announce_rejoin(2, host=1)
+        with pytest.raises(RejoinRefused, match="went silent"):
+            mon.validate_rejoin(1, 2)
+        # a refusal leaves the degraded membership untouched
+        assert mon.survivors() == [0] and mon.lost() == [1]
+    finally:
+        mon.stop()
+
+
+def test_health_rejoin_dead_incarnation_heartbeat_refused(tmp_path):
+    """Liveness means a FRESH seq stream: the dead incarnation's stale
+    heartbeat file (seq recorded before the loss) never passes."""
+    from cst_captioning_tpu.resilience.health import (
+        HealthMonitor,
+        RejoinRefused,
+    )
+
+    now = {"t": 0.0}
+    clock = lambda: now["t"]  # noqa: E731
+    a = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2, timeout_s=1.0,
+                      misses=1, clock=clock, start_thread=False).start()
+    b = HealthMonitor(str(tmp_path), host_id=1, num_hosts=2, timeout_s=1.0,
+                      misses=1, clock=clock, start_thread=False).start()
+    try:
+        b.beat()
+        a.poll()  # A records B's pre-loss seq
+        a.simulate_loss(1)
+        a.acknowledge()
+        a.announce_rejoin(a.generation + 1, host=1)
+        with pytest.raises(RejoinRefused, match="predates the loss"):
+            a.validate_rejoin(1, a.generation + 1)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_attempt_rejoin_budget_exhaustion_counts_refusal(tmp_path):
+    """attempt_rejoin retries refused validations under the budgeted policy,
+    then gives up with the counters telling the story — and the degraded
+    membership untouched."""
+    from cst_captioning_tpu.resilience.health import (
+        HealthMonitor,
+        RejoinRefused,
+        attempt_rejoin,
+    )
+
+    mon = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2,
+                        start_thread=False).start()
+    try:
+        mon.simulate_loss(1)
+        mon.acknowledge()
+        attempts = _counter("resilience.regrow.attempts")
+        refused = _counter("resilience.regrow.refused")
+        sleeps = []
+        policy = RetryPolicy(max_attempts=3, base_delay=0.01, budget=10.0,
+                             retry_on=(RejoinRefused, OSError))
+        # no rejoin marker at all: every validation attempt is refused
+        with pytest.raises(RejoinRefused, match="marker absent"):
+            attempt_rejoin(mon, 1, 1, policy=policy, sleep=sleeps.append)
+        assert len(sleeps) == 2  # retried to the attempt cap, then gave up
+        assert _counter("resilience.regrow.attempts") == attempts + 1
+        assert _counter("resilience.regrow.refused") == refused + 1
+        assert mon.survivors() == [0] and mon.lost() == [1]
+    finally:
+        mon.stop()
+
+
+def test_health_rejoin_marker_torn_read_tolerated(tmp_path):
+    """A torn/corrupt rejoin marker reads as 'no news', never a crash — and
+    the monitor's own publishes are tmp-then-rename, so it can't produce
+    one itself."""
+    from cst_captioning_tpu.resilience.health import (
+        HealthMonitor,
+        RejoinRefused,
+    )
+
+    mon = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2,
+                        start_thread=False).start()
+    try:
+        mon.simulate_loss(1)
+        mon.acknowledge()
+        (tmp_path / "host1.rejoin").write_text('{"host": 1, "generat')
+        assert mon.read_rejoin(1) is None
+        assert mon.pending_rejoins() == {}
+        with pytest.raises(RejoinRefused, match="absent or unreadable"):
+            mon.validate_rejoin(1, 1)
+        # a non-dict payload is equally 'no news'
+        (tmp_path / "host1.rejoin").write_text('[1, 2]')
+        assert mon.read_rejoin(1) is None
+        # the monitor's own writes never leave .tmp litter behind
+        mon.beat()
+        mon.announce_rejoin(1)
+        assert not [n for n in os.listdir(str(tmp_path)) if ".tmp" in n]
+    finally:
+        mon.stop()
+
+
+def test_chaos_host_rejoin_requires_active_monitor():
+    plan = FaultPlan([Fault("health.rejoin", "host_rejoin", at=0, host=1)])
+    with plan.activate():
+        with pytest.raises(RuntimeError, match="HealthMonitor"):
+            chaos.visit("health.rejoin")
+
+
+def test_chaos_host_rejoin_flaky_announces_without_checkin(tmp_path):
+    """The flaky rejoiner announces (marker + fresh heartbeat — validation
+    would PASS) and then dies before the rendezvous check-in; the plain
+    kind checks in, so only the flaky run's regrow rendezvous times out."""
+    from cst_captioning_tpu.resilience.health import HealthMonitor
+
+    mon = HealthMonitor(str(tmp_path), host_id=0, num_hosts=2, misses=1,
+                        start_thread=False).start()
+    try:
+        mon.simulate_loss(1)
+        mon.acknowledge()
+        gen = mon.generation + 1
+        checkin = tmp_path / f"rendezvous_{gen:04d}" / "host1.json"
+        plan = FaultPlan(
+            [Fault("health.rejoin", "host_rejoin_flaky", at=0, host=1)]
+        )
+        with plan.activate():
+            chaos.visit("health.rejoin")
+        assert list(mon.pending_rejoins()) == [1]
+        mon.validate_rejoin(1, gen)  # liveness checks out...
+        assert not checkin.exists()  # ...but it died mid-rendezvous
+        assert [f["kind"] for f in plan.fired] == ["host_rejoin_flaky"]
+        assert plan.faults[0].host == 1  # the rejoiner rides the host field
+        # the plain kind pre-checks the phantom into the rendezvous
+        plan2 = FaultPlan(
+            [Fault("health.rejoin", "host_rejoin", at=0, host=1)]
+        )
+        with plan2.activate():
+            chaos.visit("health.rejoin")
+        assert checkin.exists()
+    finally:
+        mon.stop()
+
+
 def test_collective_span_emits_stall_event_past_threshold():
     from cst_captioning_tpu.resilience.health import collective_span
 

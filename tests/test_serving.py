@@ -340,6 +340,70 @@ def test_snapshot_records_page_table_and_order(setup, tmp_path):
     assert set(lead) == inflight
 
 
+def test_snapshot_replays_onto_regrown_service(setup, tmp_path):
+    """ISSUE 17 serving arc: a drained shard's queue+page snapshot replays
+    onto a rejoined node — the replacement service comes up at the reduced
+    width the outage left it, grows its lane pool back at a stride seam
+    (pages added to the bank, lanes born finished), and completes the
+    drained requests bit-identically to an undrained full-width run."""
+    model, params = setup
+    reqs = _requests()
+    base = CaptionService(model, params, capacity=4, num_rollouts=2,
+                          stride=4, frame_bucket=2).serve(reqs)
+
+    snap = str(tmp_path / "regrow")
+    plan = FaultPlan([Fault("serving.step", "serving_preempt", at=3)])
+    svc = CaptionService(model, params, capacity=4, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    with plan.activate():
+        drained = svc.serve(_requests(), snapshot_dir=snap)
+    assert drained.drained and drained.completed < len(reqs)
+
+    # the rejoined node starts at the degraded width, then grows back to
+    # full width before admissions resume
+    regrown = CaptionService(model, params, capacity=2, num_rollouts=2,
+                             stride=4, frame_bucket=2)
+    pages_before = regrown.bank.num_pages
+    restored = load_snapshot(snap, service=regrown, grow_to=4)
+    assert len(restored) == len(reqs) - drained.completed
+    assert regrown.B == 4 and len(regrown._free_slots) == 4
+    assert (regrown.bank.num_pages
+            == pages_before + 2 * regrown.table_width)
+    replay = regrown.serve(())  # the replayed queue is already submitted
+    union = dict(drained.results)
+    union.update(replay.results)
+    assert set(union) == set(base.results)
+    for rid, res in base.results.items():
+        np.testing.assert_array_equal(union[rid].tokens, res.tokens, rid)
+        np.testing.assert_array_equal(
+            union[rid].logprobs, res.logprobs, rid
+        )
+
+
+def test_grow_capacity_with_live_state_preserves_parity(setup):
+    """Growing the lane pool between serve calls (live lane state present)
+    pads every lane-axis leaf with finished, empty lanes: later requests
+    admitted at the grown width still decode bit-identically to the
+    offline oracle, and shrinking in place is refused."""
+    model, params = setup
+    svc = CaptionService(model, params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    first = _requests(frames=(1, 8, 3), seed0=1000)
+    r1 = svc.serve(first)
+    assert r1.completed == 3 and svc._state is not None
+    svc.grow_capacity(5)
+    assert svc.B == 5 and len(svc._free_slots) == 5
+    second = [
+        dataclasses.replace(r, req_id="g" + r.req_id)
+        for r in _requests(frames=(8, 2, 5, 4, 6), seed0=2000)
+    ]
+    r2 = svc.serve(second)
+    assert set(r2.results) >= {r.req_id for r in second}
+    _assert_parity(model, params, r2, second)
+    with pytest.raises(ValueError, match="only grows"):
+        svc.grow_capacity(2)
+
+
 def test_sigterm_drains_the_loop(setup, tmp_path):
     """A real SIGTERM mid-serve stops at the next stride boundary via the
     PreemptionHandler path (drain_reason='sigterm')."""
