@@ -23,7 +23,12 @@ trivially idle (or hopelessly overloaded) one on slow hosts.
 A parity block re-decodes sampled requests OFFLINE through
 ``decoding.fused.fused_decode`` and requires token- AND logprob-bit-exact
 agreement with the served results (the continuous engine's per-request
-determinism contract, also pinned by tests/test_serving.py). FLOPs for the
+determinism contract, also pinned by tests/test_serving.py). It also
+covers the ADMISSION seam: grouped (batched) admission encode must be
+bit-exact vs per-request admission at f32, and at bf16 the engine's
+fall-back to per-request encode is verified engaged, with the
+batched-vs-solo bf16 encoder drift it avoids measured and bounded
+(tolerance documented in the block). FLOPs for the
 MFU field come from XLA's HLO cost analysis of the compiled stride program
 (``obs/flops.compiled_cost``) with the analytic model as fallback.
 
@@ -43,6 +48,7 @@ Usage: python bench_serving.py [--smoke] [--requests N] [--capacity N]
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 import time
@@ -295,6 +301,79 @@ def main() -> None:
             )
             parity_checked += 1
 
+    # ---- admission-group parity -------------------------------------------
+    # grouped admission encode must be ROW-stable: at f32 a batched encoder
+    # pass admits the same bits as per-request admission (pinned bit-exact
+    # here and in tests/test_serving.py); at bf16 the batched pass can
+    # legitimately drift (reduction order inside the matmuls changes with
+    # the batch dim), which is WHY the engine falls back to per-request
+    # encode at bf16 — the drift is measured and bounded here, mirroring
+    # the decode kernel's bf16 parity story
+    ag_n = 4
+    ag_spec = TrafficSpec(kind="poisson", rate_rps=1e9, num_requests=ag_n,
+                          seed=23, frame_choices=(frames,))
+    if dtype == "float32":
+        grouped, solo_adm = (
+            CaptionService(
+                model, params, capacity=ag_n, num_rollouts=K,
+                max_len=max_len, stride=stride, admit_group=g,
+            ).serve(requests_for(make_trace(ag_spec)))
+            for g in (ag_n, 1)
+        )
+        ag_f32_exact = all(
+            np.array_equal(grouped.results[rid].tokens,
+                           solo_adm.results[rid].tokens)
+            and np.array_equal(grouped.results[rid].logprobs,
+                               solo_adm.results[rid].logprobs)
+            for rid in grouped.results
+        )
+        model_bf = CaptionModel(dataclasses.replace(cfg, dtype="bfloat16"))
+    else:
+        ag_f32_exact = (
+            "skipped: bf16 operating point — grouped f32 admission is "
+            "pinned by tests/test_serving.py and the smoke run"
+        )
+        model_bf = model
+    # the engine refuses grouped admission at bf16 (falls back to 1)
+    ag_bf16_fallback = CaptionService(
+        model_bf, params, capacity=ag_n, num_rollouts=K, max_len=max_len,
+        stride=stride, admit_group=ag_n,
+    )
+    bf16_fell_back = (ag_bf16_fallback.requested_admit_group == ag_n
+                      and ag_bf16_fallback.admit_group == 1)
+    # measure the batched-vs-solo bf16 encoder drift the fallback avoids
+    enc_bf = jax.jit(lambda p, f, m: model_bf.apply(
+        p, f, m, method=CaptionModel.encode
+    ))
+    ag_reqs = requests_for(make_trace(ag_spec))
+    feats_b = {
+        name: jnp.asarray(np.stack(
+            [np.asarray(r.feats[name], np.float32) for r in ag_reqs]
+        )) for name, _ in modal
+    }
+    masks_b = {
+        name: jnp.asarray(np.stack(
+            [np.asarray(r.masks[name], np.float32) for r in ag_reqs]
+        )) for name, _ in modal
+    }
+    enc_batched = enc_bf(params, feats_b, masks_b)
+    bf16_drift = bf16_scale = 0.0
+    for i in range(ag_n):
+        enc_solo = enc_bf(
+            params,
+            {k: v[i:i + 1] for k, v in feats_b.items()},
+            {k: v[i:i + 1] for k, v in masks_b.items()},
+        )
+        for a, b in ((enc_batched.memory[i:i + 1], enc_solo.memory),
+                     (enc_batched.memory_proj[i:i + 1],
+                      enc_solo.memory_proj)):
+            a32 = np.asarray(a, np.float32)
+            b32 = np.asarray(b, np.float32)
+            bf16_drift = max(bf16_drift, float(np.max(np.abs(a32 - b32))))
+            bf16_scale = max(bf16_scale, float(np.max(np.abs(b32))))
+    bf16_tol = 0.05  # a few bf16 ulps relative to the encoder output scale
+    bf16_within = bf16_drift <= bf16_tol * max(bf16_scale, 1e-9)
+
     feat_dims = tuple(d for _, d in modal)
     _, per_tok = enc_and_per_tok_flops(
         frames, d_embed, d_hidden, d_att, vocab_n, feat_dims, 1
@@ -315,13 +394,18 @@ def main() -> None:
         for name, t in traces_out.items()
     }
     if args.smoke:
-        ok = parity_ok and all(
-            t["continuous"]["goodput_rps"] > 0 for t in traces_out.values()
-        )
+        ok = parity_ok and ag_f32_exact is True and bf16_fell_back \
+            and bf16_within and all(
+                t["continuous"]["goodput_rps"] > 0
+                for t in traces_out.values()
+            )
         if not ok:
             sys.exit(
-                "bench_serving: SMOKE FAILURE — parity or goodput gate "
-                f"failed: parity={parity_ok}, traces={traces_out}"
+                "bench_serving: SMOKE FAILURE — parity, admission-group, "
+                f"or goodput gate failed: parity={parity_ok}, "
+                f"admit_group_f32={ag_f32_exact}, "
+                f"bf16_fallback={bf16_fell_back}, "
+                f"bf16_drift_within_tol={bf16_within}, traces={traces_out}"
             )
         # the SLO monitor must have judged the served traffic: target gauge
         # armed by set_slo() and per-window attainment/burn-rate populated
@@ -357,6 +441,12 @@ def main() -> None:
         "parity": {
             "continuous_vs_offline_bit_exact": parity_ok,
             "checked_requests": parity_checked,
+            "admit_group_size": ag_n,
+            "admit_group_f32_bit_exact": ag_f32_exact,
+            "admit_group_bf16_fallback_engaged": bf16_fell_back,
+            "admit_group_bf16_encode_max_drift": bf16_drift,
+            "admit_group_bf16_drift_tol_frac": bf16_tol,
+            "admit_group_bf16_drift_within_tol": bool(bf16_within),
         },
         "flops": {
             "per_stride_hlo": (stride_cost or {}).get("flops"),

@@ -396,6 +396,18 @@ class RLConfig:
     # remainder learn); both sides are clamped to >= 1 device, and a 1-device
     # mesh (or mesh=None) runs both roles on the same device
     actor_fraction: float = 0.5
+    # ---- online serving-as-actor knobs (rl/online.py; README "Online RL
+    # from served traffic") ----
+    # completed served requests buffered per learner batch before the
+    # batch enters the rollout ring (the online analogue of
+    # data.batch_size; a trailing partial buffer waits for more traffic)
+    online_batch_size: int = 4
+    # learner updates between param publishes into the live CaptionService
+    # (1 = publish after every update). The publish is version-stamped with
+    # the learner's update counter and applies at the service's next stride
+    # boundary — drain-free, with in-flight requests pinned to their
+    # admission version
+    swap_every: int = 1
 
 
 @dataclass(frozen=True)
@@ -535,6 +547,16 @@ class ExperimentConfig:
                     "train.rl_topology='decoupled' is not implemented for "
                     "the sequence-parallel ('seq_devices > 1') path"
                 )
+        if self.rl.online_batch_size < 1:
+            raise ValueError(
+                f"rl.online_batch_size {self.rl.online_batch_size} must be "
+                ">= 1 (served requests per online learner batch)"
+            )
+        if self.rl.swap_every < 1:
+            raise ValueError(
+                f"rl.swap_every {self.rl.swap_every} must be >= 1 (learner "
+                "updates between param publishes into the serving engine)"
+            )
         if self.mesh.seq_devices > 1 and (
             self.train.comm_dtype != "f32" or self.train.comm_overlap
         ):

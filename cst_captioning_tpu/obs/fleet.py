@@ -58,6 +58,7 @@ _FLEET_EVENTS = (
     "dcn_stall", "anomaly", "divergence", "preempt", "peer_loss_drain",
     "serving_drain", "postmortem",
     "mesh_shrink", "mesh_regrow", "regrow_refused",
+    "serving_param_swap", "serving_param_swap_refused",
 )
 _MAX_FLEET_EVENTS = 200
 
@@ -418,6 +419,23 @@ def merge_bundles(run_dir: str) -> dict[str, Any]:
         for p, pm in sorted(procs.items())
     ]
 
+    # serving param-version attribution: procs that served carry a
+    # registry "serving" block (engine._drain_postmortem registry_extra)
+    # naming the ACTIVE param version at dump plus the recent swap
+    # history — a reward/SLO regression in the timeline joins to the
+    # version that served it
+    serving_att: dict[int, dict] = {}
+    for p, pm in sorted(procs.items()):
+        sv = (pm.get("registry") or {}).get("serving")
+        if isinstance(sv, dict) and "param_version" in sv:
+            serving_att[p] = {
+                "host": pm["meta"].get("host", "?"),
+                "param_version": sv.get("param_version"),
+                "param_swaps": sv.get("param_swaps", 0),
+                "swap_history": list(sv.get("swap_history") or []),
+            }
+    fleet["serving"] = serving_att or None
+
     # events_tail interleave: per-proc obs events (dcn stalls, anomaly
     # verdicts, drains) at offset-corrected times. Tail timestamps are
     # already wall-clock (span stream), so only the cross-host offset
@@ -450,7 +468,8 @@ def merge_bundles(run_dir: str) -> dict[str, Any]:
             }
             for k in ("kind", "op", "dur_s", "gap_s", "reason", "step",
                       "phase", "value", "victim", "rejoiner", "generation",
-                      "devices"):
+                      "devices", "version", "prev", "active",
+                      "inflight_pinned"):
                 if k in ev:
                     out[k] = ev[k]
             events.append(out)
@@ -571,6 +590,16 @@ def render_fleet(fleet: dict[str, Any]) -> str:
                 f"elastic: host {arc['host']} shrink t+"
                 f"{arc['shrink_t_s']:.3f}s --> (never rejoined{refused})"
             )
+    for p, sv in sorted((fleet.get("serving") or {}).items()):
+        hist = sv.get("swap_history") or []
+        arrows = "->".join(
+            str(h.get("from")) for h in hist[:1]
+        ) + "".join(f"->{h.get('version')}" for h in hist)
+        lines.append(
+            f"serving: proc{p} ({sv['host']}) active param v"
+            f"{sv['param_version']} after {int(sv['param_swaps'])} swap(s)"
+            + (f"  [{arrows}]" if hist else "")
+        )
 
     steps = fleet.get("steps", [])
     if not steps:
@@ -628,7 +657,8 @@ def render_fleet(fleet: dict[str, Any]) -> str:
         ):
             ev = events[ev_i]
             detail = "  ".join(
-                f"{k}={ev[k]}" for k in ("kind", "op", "dur_s", "reason")
+                f"{k}={ev[k]}"
+                for k in ("kind", "op", "dur_s", "reason", "version", "prev")
                 if k in ev
             )
             lines.append(

@@ -64,6 +64,7 @@ from cst_captioning_tpu.obs.span import (
 _PROBE_GAUGES = (
     "comm.bytes_on_wire", "comm.buckets", "health.peers_alive",
     "health.peer_age_max_s", "serving.slo.burn_rate.60s",
+    "serving.param_version",
     "rl.actor.occupancy", "rl.learner.occupancy",
 )
 _PROBE_COUNTERS = (

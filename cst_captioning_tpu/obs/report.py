@@ -280,6 +280,13 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
             "pages_in_use": gauges.get("serving.pages_in_use"),
             "slots_in_use": gauges.get("serving.slots_in_use"),
             "queue_depth": gauges.get("serving.queue_depth"),
+            # drain-free hot param swap (serving/engine.publish_params):
+            # the active learner-param version plus applied/refused swaps
+            "param_version": gauges.get("serving.param_version"),
+            "param_swaps": counters.get("serving.param_swaps", 0),
+            "param_swaps_refused": counters.get(
+                "serving.param_swaps_refused", 0
+            ),
         }
         # SLO burn-rate monitor (serving/engine.SloMonitor): rolling-window
         # attainment/burn gauges + breach/alert counters, keyed by window
@@ -555,6 +562,18 @@ def render_report(report: dict[str, Any]) -> str:
             )
         if sv.get("pages_in_use") is not None:
             bits.append(f"pages in use: {int(sv['pages_in_use'])}")
+        if sv.get("param_swaps") or sv.get("param_swaps_refused"):
+            bits.append(
+                f"param swaps: {int(sv['param_swaps'])} applied"
+                + (
+                    f" / {int(sv['param_swaps_refused'])} refused"
+                    if sv.get("param_swaps_refused") else ""
+                )
+                + (
+                    f" (active v{int(sv['param_version'])})"
+                    if sv.get("param_version") is not None else ""
+                )
+            )
         if bits:
             lines.append("  " + "   ".join(bits))
     ev = report.get("eval")

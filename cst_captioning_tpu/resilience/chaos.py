@@ -60,6 +60,12 @@ Fault kinds:
   (marker + heartbeat land) and then dies mid-rendezvous, so the
   survivors' regrow rendezvous times out and the run continues degraded —
   a failed rejoin must never become a second outage.
+- ``"param_swap"`` — preempt the serving loop exactly at the hot
+  param-swap seam (fire at ``serving.param_swap``: the visit sits between
+  a staged publish and its application). The swap machinery refuses the
+  publish and drains under the OLD version, so the snapshot replays
+  bit-identically — the swap is fully applied or fully refused, never a
+  torn version (tests/test_serving.py pins both arms).
 
 Injection points currently compiled in:
 
@@ -75,6 +81,7 @@ Injection points currently compiled in:
 ``ckpt.pre_replace``    tmp dir complete + fsync'd, final rename not yet done
 ``reward.call``    inside the retried RL reward invocation
 ``serving.step``   serving admission loop, once per iteration (main thread)
+``serving.param_swap``  between a staged param publish and its application
 ``rl.actor.step``  decoupled RL actor loop, once per decoded batch
 ``health.rejoin``  degraded trainer's rejoin poll, once per batch boundary
 =================  =========================================================
@@ -131,7 +138,7 @@ class Fault:
     _KINDS = ("kill", "preempt", "io_error", "nan", "slow", "slow_h2d",
               "partial_h2d", "wedged_prefetch", "enospc_rotation",
               "partial_preempt", "serving_preempt", "actor_preempt",
-              "host_rejoin", "host_rejoin_flaky")
+              "host_rejoin", "host_rejoin_flaky", "param_swap")
 
     def __post_init__(self):
         if self.kind not in self._KINDS:
@@ -243,6 +250,13 @@ class FaultPlan:
                 from cst_captioning_tpu.serving import engine as serving
 
                 serving.request_drain("chaos_serving_preempt")
+            elif f.kind == "param_swap":
+                # a preemption landing exactly mid-swap: the service's
+                # swap seam sees the drain request before mutating and
+                # refuses the publish (fully applied or fully refused)
+                from cst_captioning_tpu.serving import engine as serving
+
+                serving.request_drain("chaos_param_swap")
             elif f.kind == "actor_preempt":
                 # lazy import: rl pulls jax in, same contract as serving
                 from cst_captioning_tpu.rl import async_scst

@@ -14,6 +14,7 @@ from cst_captioning_tpu.rl.async_scst import (
     make_actor_decode,
     request_actor_preempt,
 )
+from cst_captioning_tpu.rl.online import OnlineSCSTTrainer
 from cst_captioning_tpu.rl.rewards import RewardComputer, scb_baseline
 from cst_captioning_tpu.rl.scst import (
     SCSTTrainer,
@@ -25,6 +26,7 @@ from cst_captioning_tpu.rl.scst import (
 
 __all__ = [
     "AsyncSCSTTrainer",
+    "OnlineSCSTTrainer",
     "RewardComputer",
     "RolloutRing",
     "scb_baseline",

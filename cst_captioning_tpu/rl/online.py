@@ -1,0 +1,274 @@
+"""Online SCST from served traffic: the serving-as-actor feedback loop.
+
+The decoupled topology (rl/async_scst.py) made the actor a separate
+submesh; this module makes it the SERVING ENGINE. A live
+:class:`~cst_captioning_tpu.serving.engine.CaptionService` already decodes
+the exact fused (1+K)-lane programs SCST trains on — lane 0 greedy, K
+sampled lanes on the request's own RNG stream — and until now threw the
+sampled lanes away after NPAD best-lane selection. The feedback capture
+turns each completed request into an actor rollout at ZERO extra dispatch
+(tokens and logprobs are already host arrays at completion), scored by the
+consensus :class:`~cst_captioning_tpu.rl.rewards.RewardComputer` against a
+reference pool and consumed through the PR 15 :class:`RolloutRing` and the
+existing ``rl_update`` factories. After each learner update the new params
+publish back into the service through the drain-free hot swap
+(:meth:`CaptionService.publish_params`), closing the loop: the service
+improves while it serves (the RLAX serving+training shape, PAPERS.md
+arXiv 2512.06392).
+
+**Staleness: drop-and-COUNT, not drop-and-recount.** The decoupled trainer
+re-decodes an over-stale rollout under fresh params (its RNG key is stored;
+a rollout is just a sample, so recounting is free and deterministic). A
+TRAFFIC entry is different: its tokens were SERVED — they are ground truth
+about a live interaction under the version that served it, and re-decoding
+would fabricate traffic that never happened. So an entry whose admission
+version lags the learner by more than ``rl.staleness_bound`` updates is
+dropped and *counted* (``rl.online.dropped_stale`` + the staleness
+histogram), never recounted. The drop sequence is a deterministic function
+of (trace, swap schedule), which is what makes two seeded online runs
+produce bit-identical learner params (tests/test_rl_online.py).
+
+**Version arithmetic.** The learner's update counter IS the version
+namespace: every applied update bumps ``self.version``; a publish stamps
+the service with the learner version at publish time, and a request's
+admission pins that stamp. Staleness of a capture is therefore measured in
+learner updates, exactly like the decoupled trainer's — one counter, no
+translation. A mixed-version batch (captures straddling a swap) takes the
+OLDEST member's version: conservative, and deterministic.
+
+Single-process by construction (``mesh=None``): the learner shares the
+serving host, which is the CPU/single-chip shape benches and tests run.
+The learner-submesh split composes later through the same
+``SCSTTrainer(mesh=...)`` machinery the async trainer uses (ROADMAP
+residual).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from cst_captioning_tpu import obs
+from cst_captioning_tpu.config.config import RLConfig
+from cst_captioning_tpu.rl.async_scst import AsyncSCSTTrainer, RolloutRing
+from cst_captioning_tpu.rl.rewards import RewardComputer
+from cst_captioning_tpu.rl.scst import SCSTTrainer
+from cst_captioning_tpu.train.state import TrainState
+
+
+class OnlineSCSTTrainer(SCSTTrainer):
+    """SCSTTrainer fed by served traffic instead of a dataset epoch.
+
+    Wire-up (the closed loop)::
+
+        trainer = OnlineSCSTTrainer(model, reward, cfg, state)
+        svc = CaptionService(model, state.params, ...)
+        trainer.attach(svc)          # feedback capture + publish target
+        svc.serve(requests)          # captures -> ring -> updates -> swaps
+        trainer.flush()              # consume what the ring still holds
+        state = trainer.state
+
+    :meth:`on_result` is the service's feedback hook: it buffers completed
+    requests into learner batches of ``cfg.online_batch_size``, pushes full
+    batches into a depth-``cfg.rollout_depth`` :class:`RolloutRing`, and
+    consumes ring entries once the ring is full — score (consensus reward
+    vs the reference pool, greedy lane as the SCST baseline), staleness
+    gate (drop-and-count, module docstring), REINFORCE update, then a
+    version-stamped param publish into the attached service every
+    ``cfg.swap_every`` updates. Everything runs on the serving thread in
+    deterministic order.
+
+    ``ref_id`` maps a :class:`ClipRequest` to the reward pool's video id
+    (default: the request id verbatim — the bench/test convention where
+    requests are named after their source clips).
+    """
+
+    _STALE_BUCKETS = AsyncSCSTTrainer._STALE_BUCKETS
+
+    def __init__(self, model, reward: RewardComputer, cfg: RLConfig,
+                 state: TrainState, *, max_len: int | None = None,
+                 ref_id: Callable | None = None, donate: bool = False,
+                 guard: bool = False, retry=None, on_event=None, comm=None,
+                 stats: bool = False):
+        super().__init__(
+            model, reward, cfg, mesh=None, max_len=max_len, donate=donate,
+            guard=guard, retry=retry, on_event=on_event, comm=comm,
+            stats=stats,
+        )
+        self.state = state
+        self._donate = bool(donate)
+        self._bound = max(0, int(getattr(cfg, "staleness_bound", 1)))
+        self._batch_size = max(1, int(getattr(cfg, "online_batch_size", 4)))
+        self._swap_every = max(1, int(getattr(cfg, "swap_every", 1)))
+        self._ref_id = ref_id or (lambda req: req.req_id)
+        self._ring = RolloutRing(
+            max(1, int(getattr(cfg, "rollout_depth", 2)))
+        )
+        self._buffer: list[dict] = []
+        self._service = None
+        # the learner's update counter IS the param-version namespace
+        self.version = 0
+        # run ledgers the bench/tests read back
+        self.last_dropped = 0
+        self.last_applied = 0
+        self.last_staleness: dict[int, int] = {}
+        self.history: list[dict] = []   # per-update metrics (reward trend)
+
+    # ---- wiring -------------------------------------------------------------
+
+    def attach(self, service, swap_every: int | None = None) -> None:
+        """Bind a live :class:`CaptionService`: its completions feed
+        :meth:`on_result`, and every ``swap_every``-th learner update
+        publishes params back for the drain-free hot swap.
+
+        Requires a version-aligned service (a fresh one, or one whose
+        active version equals the learner's) so admission stamps and the
+        learner counter share one namespace, and a non-donating update
+        (``donate=False``): published param trees stay live inside the
+        service across later updates — a donating update would invalidate
+        the buffers the service still decodes from."""
+        if self._donate:
+            raise ValueError(
+                "OnlineSCSTTrainer.attach needs donate=False — the service "
+                "keeps decoding from published param buffers after later "
+                "updates run"
+            )
+        if service.param_version != self.version:
+            raise ValueError(
+                f"service param_version {service.param_version} != learner "
+                f"version {self.version} — attach a fresh (or version-"
+                "aligned) service so staleness arithmetic shares one counter"
+            )
+        if swap_every is not None:
+            self._swap_every = max(1, int(swap_every))
+        self._service = service
+        service._feedback = self.on_result
+
+    # ---- the feedback capture (CaptionService hook) -------------------------
+
+    def on_result(self, req, result, param_version: int) -> None:
+        """Feedback hook: one completed served request becomes one rollout
+        row. Zero extra dispatch — ``result.tokens``/``logprobs`` are the
+        host arrays the service already read back at the stride seam."""
+        K = self.cfg.num_rollouts
+        if result.tokens.shape[0] != 1 + K:
+            raise ValueError(
+                f"served request {req.req_id!r} has "
+                f"{result.tokens.shape[0]} lanes; the online learner is "
+                f"configured for 1+K={1 + K}"
+            )
+        self._buffer.append({
+            "req_id": req.req_id,
+            "seed": int(req.seed),
+            "version": int(param_version),
+            "video_id": self._ref_id(req),
+            "greedy": np.asarray(result.tokens[0], np.int32),
+            "samples": np.asarray(result.tokens[1:], np.int32),
+            "lps": np.asarray(result.logprobs[1:], np.float32),
+            "feats": req.feats,
+            "masks": req.masks,
+        })
+        obs.counter("rl.online.captured").inc()
+        if len(self._buffer) >= self._batch_size:
+            self._push_batch()
+        while len(self._ring) >= self._ring.depth:
+            self._consume_one()
+
+    @property
+    def pending_captures(self) -> int:
+        """Captures buffered toward the next (not yet full) batch."""
+        return len(self._buffer)
+
+    def flush(self) -> int:
+        """Consume every COMPLETE batch still in the ring (end-of-trace /
+        pre-drain). A trailing partial capture buffer stays put — batch
+        shapes through the ring are constant, and more traffic may land;
+        ``pending_captures`` exposes what waits."""
+        n = 0
+        while len(self._ring):
+            self._consume_one()
+            n += 1
+        return n
+
+    # ---- batch forming ------------------------------------------------------
+
+    def _push_batch(self) -> None:
+        batch, self._buffer = (
+            self._buffer[:self._batch_size],
+            self._buffer[self._batch_size:],
+        )
+        F = self.model.cfg.max_frames
+        feats: dict[str, np.ndarray] = {}
+        masks: dict[str, np.ndarray] = {}
+        for name, _ in self.model.cfg.modalities:
+            rows, mrows = [], []
+            for cap in batch:
+                x = np.asarray(cap["feats"][name], np.float32)
+                mk = np.asarray(cap["masks"][name], np.float32)
+                pad = F - x.shape[0]
+                rows.append(np.pad(x, ((0, pad), (0, 0))))
+                mrows.append(np.pad(mk, ((0, pad),)))
+            feats[name] = np.stack(rows)
+            masks[name] = np.stack(mrows)
+        greedy = np.stack([cap["greedy"] for cap in batch])        # [B, T]
+        samples = np.stack(
+            [cap["samples"] for cap in batch], axis=1
+        )                                                          # [K, B, T]
+        lps = np.stack([cap["lps"] for cap in batch], axis=1)
+        self._ring.push(
+            greedy, samples, lps,
+            # a mixed-version batch is as stale as its OLDEST capture
+            version=min(cap["version"] for cap in batch),
+            feats=feats, masks=masks,
+            video_ids=[cap["video_id"] for cap in batch],
+            valid_np=np.ones((len(batch),), np.float32),
+            req_ids=[cap["req_id"] for cap in batch],
+            seeds=[cap["seed"] for cap in batch],
+        )
+        obs.counter("rl.online.batches").inc()
+        obs.gauge("rl.online.ring_occupancy").set(float(len(self._ring)))
+
+    # ---- consumption --------------------------------------------------------
+
+    def _consume_one(self) -> None:
+        meta, greedy, samples, lps = self._ring.pop()
+        stale = self.version - meta["version"]
+        self.last_staleness[stale] = self.last_staleness.get(stale, 0) + 1
+        obs.histogram("rl.online.staleness", self._STALE_BUCKETS).observe(
+            float(stale)
+        )
+        if stale > self._bound:
+            # drop-and-COUNT: served tokens are ground truth from a live
+            # interaction under an old version — unlike an actor rollout
+            # there is nothing to recount (module docstring). Dropped,
+            # counted, never re-decoded; deterministic run-to-run.
+            self.last_dropped += 1
+            obs.counter("rl.online.dropped_stale").inc()
+            self.on_event(
+                "rl_online_dropped", staleness=stale,
+                version=meta["version"], req_ids=meta["req_ids"],
+            )
+            return
+        with obs.span("rl.online.step"):
+            scored = self._score(
+                greedy, samples, meta["feats"], meta["masks"],
+                meta["video_ids"], meta["valid_np"],
+            )
+            self.state, m = self._apply(self.state, *scored)
+        self.version += 1
+        self.last_applied += 1
+        obs.counter("rl.online.steps").inc()
+        m = dict(m, staleness=stale, param_version=self.version)
+        self.history.append(m)
+        self.on_event("rl_online_step", **{
+            k: m[k] for k in ("reward_mean", "staleness", "param_version")
+            if k in m
+        })
+        if (self._service is not None
+                and self.version % self._swap_every == 0):
+            # version-stamped publish into the live service; the swap
+            # applies at the service's next stride boundary — drain-free
+            self._service.publish_params(
+                self.state.params, version=self.version
+            )

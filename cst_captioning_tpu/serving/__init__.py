@@ -17,6 +17,14 @@ package productionizes it into a request-serving layer (README "Serving"):
 Every request decodes on its OWN fold_in RNG stream, so a request admitted
 mid-flight is token- and logprob-bit-identical to the same clip decoded
 offline through decoding/fused.py (pinned by tests/test_serving.py).
+
+The engine is also the ONLINE RL actor (README "Online RL from served
+traffic"): a ``feedback`` hook hands every completed request's lanes to
+:class:`~cst_captioning_tpu.rl.online.OnlineSCSTTrainer`, and
+:meth:`CaptionService.publish_params` hot-swaps learner params back in at
+a stride boundary — drain-free, with in-flight requests pinned to their
+admission-time param version (still bit-identical to the offline decode
+under that version).
 """
 
 from cst_captioning_tpu.serving.engine import (

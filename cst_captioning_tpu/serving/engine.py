@@ -103,6 +103,7 @@ class CaptionResult:
     caption: str | None       # detokenized when the service has a vocab
     latency_s: float          # arrival -> completion (queue wait included)
     phases: dict[str, float]  # queue_wait / encode / decode / detok seconds
+    param_version: int = 0    # admission-pinned version this decode ran under
 
 
 @dataclass
@@ -127,6 +128,11 @@ class _Ticket:
     t_submit: float = 0.0
     t_admit: float = 0.0
     t_encoded: float = 0.0
+    # the param version active at admission: every stride of this request
+    # decodes under THIS version's params even after a hot swap (per-lane
+    # version pinning — the request is bit-identical to its offline decode
+    # under the admission version)
+    param_version: int = 0
 
 
 class SloMonitor:
@@ -270,6 +276,7 @@ class CaptionService:
         slo_objective: float = 0.99,
         slo_fast_burn: float = 14.4,
         slo_slow_burn: float = 6.0,
+        feedback: Callable[[ClipRequest, CaptionResult, int], None] | None = None,
     ):
         cfg = model.cfg
         self.model = model
@@ -322,11 +329,23 @@ class CaptionService:
         # request, which is what makes a served request bit-identical to
         # its offline B=1 decode at EVERY dtype. >1 batches same-bucket
         # admission encodes into one pass (less admission wall under
-        # arrival waves) — still bit-exact where the encoder gemm is
-        # row-stable (f32 on CPU/TPU, pinned by test), but bf16-on-CPU
-        # encoder gemms are batch-shape sensitive, so the parity contract
-        # only covers the default
-        self.admit_group = max(int(admit_group), 1)
+        # arrival waves) — bit-exact where the encoder gemm is row-stable
+        # (f32, pinned by test). bf16 encoder gemms are batch-shape
+        # sensitive, so at any non-f32 model dtype a requested group width
+        # > 1 FALLS BACK to per-request encode until a bf16 row-stability
+        # story exists (bench_serving ledgers the measured grouped-vs-solo
+        # bf16 drift behind the documented promotion gate)
+        self.requested_admit_group = max(int(admit_group), 1)
+        self.admit_group = self.requested_admit_group
+        if (self.admit_group > 1
+                and str(getattr(cfg, "dtype", "float32")) != "float32"):
+            self.admit_group = 1
+            obs.counter("serving.admit_group_bf16_fallback").inc()
+            obs.event(
+                "serving_admit_group_fallback",
+                requested=self.requested_admit_group,
+                dtype=str(getattr(cfg, "dtype", "float32")),
+            )
         # kernel batch-block width. 1 (default) = every lane is its own
         # block: the kernel's block-granular skips become PER-ROW skips
         # (finished rows and the compaction prefix die row by row), and each
@@ -364,6 +383,21 @@ class CaptionService:
             SloMonitor(slo_target_s, **self._slo_kw)
             if slo_target_s > 0 else None
         )
+        # ---- drain-free hot param swap state (README "Online RL from
+        # served traffic"). The ACTIVE version admits new requests; every
+        # in-flight request decodes under its admission-pinned version's
+        # params, kept in _old_params until its last lane completes. A
+        # publish is STAGED here and applied only at a stride boundary
+        # (_apply_pending_swap) — never mid-stride, never torn.
+        self.param_version = 0
+        self._old_params: dict[int, object] = {}
+        self._pending_publish: tuple[int, object] | None = None
+        self._swap_history: list[dict] = []
+        # serving-as-actor capture: called per completed request with
+        # (req, result, admission param version) — tok/lp are already host
+        # arrays at completion, so the capture is zero extra dispatch
+        self._feedback = feedback
+        obs.gauge("serving.param_version").set(0.0)
         # analytic per-token / encode FLOPs for the obs MFU counters
         feat_dims = tuple(d for _, d in cfg.modalities)
         self._enc_flops, self._tok_flops = enc_and_per_tok_flops(
@@ -475,7 +509,97 @@ class CaptionService:
                 for w in mon.windows
             },
             "breach_alerts": mon.alerts,
+            "param_version": self.param_version,
         }
+
+    # ---- drain-free hot param swap ------------------------------------------
+
+    def publish_params(self, params, version: int | None = None) -> bool:
+        """Stage a new param tree for a drain-free hot swap into the live
+        service. The swap applies at the NEXT stride boundary
+        (:meth:`_apply_pending_swap`) — in-flight requests keep decoding
+        under their admission-pinned version, new admissions pick up the
+        published one; nothing drains, nothing tears.
+
+        Version-gated: ``version`` (default: one past the newest known)
+        must be strictly newer than both the active version and any
+        still-pending publish — a stale or duplicate publish (e.g. one
+        replayed after a preemption) is REFUSED, counted, and returns
+        False. A newer publish supersedes a pending unapplied one."""
+        floor = self.param_version
+        if self._pending_publish is not None:
+            floor = max(floor, self._pending_publish[0])
+        version = floor + 1 if version is None else int(version)
+        if version <= floor:
+            obs.counter("serving.param_swaps_refused").inc()
+            obs.event(
+                "serving_param_swap_refused", version=version,
+                active=self.param_version, reason="stale_version",
+            )
+            return False
+        self._pending_publish = (version, params)
+        obs.event("serving_param_publish", version=version)
+        return True
+
+    def _apply_pending_swap(self) -> bool:
+        """Apply a staged publish at the stride boundary — the ONLY place
+        the active version ever changes, so a swap is atomic with respect
+        to strides: every stride runs entirely under whole versions.
+
+        The ``serving.param_swap`` chaos seam fires BEFORE any state
+        mutates: a preemption landing exactly mid-swap requests a drain,
+        the check below refuses the swap, and the drained snapshot replays
+        entirely under the OLD version — the swap is fully applied or
+        fully refused, never torn."""
+        if self._pending_publish is None:
+            return False
+        version, params = self._pending_publish
+        chaos.visit("serving.param_swap")
+        if self.draining:
+            self._pending_publish = None
+            obs.counter("serving.param_swaps_refused").inc()
+            obs.event(
+                "serving_param_swap_refused", version=version,
+                active=self.param_version, reason="draining",
+            )
+            return False
+        self._pending_publish = None
+        prev = self.param_version
+        if self._inflight:
+            # in-flight lanes pin the outgoing version until they complete
+            self._old_params[prev] = self.params
+        self.params = params
+        self.param_version = version
+        self._swap_history.append({
+            "version": version, "from": prev,
+            "inflight_pinned": len(self._inflight),
+        })
+        obs.counter("serving.param_swaps").inc()
+        obs.gauge("serving.param_version").set(float(version))
+        obs.event(
+            "serving_param_swap", version=version, prev=prev,
+            inflight_pinned=len(self._inflight),
+        )
+        return True
+
+    def _params_for(self, version: int):
+        """The param tree a stride for ``version``-pinned lanes decodes
+        under: the live tree for the active version, else the retained
+        tree the swap parked for still-in-flight lanes."""
+        if version == self.param_version:
+            return self.params
+        return self._old_params[version]
+
+    def _retire_versions(self) -> None:
+        """Drop retained old-param trees no in-flight lane pins anymore
+        (called after completions, so a swap's old version lives exactly
+        as long as its last admitted request)."""
+        if not self._old_params:
+            return
+        live = {t.param_version for t in self._inflight.values()}
+        for v in [v for v in self._old_params if v not in live]:
+            del self._old_params[v]
+            obs.counter("serving.param_versions_retired").inc()
 
     def serve(
         self,
@@ -513,6 +637,12 @@ class CaptionService:
                     # in-flight AND pending requests persist to the
                     # snapshot and replay from scratch bit-identically
                     break
+                # stride-boundary hot swap: a staged publish lands here,
+                # BEFORE admission, so every request admitted this
+                # iteration pins the post-swap version
+                self._apply_pending_swap()
+                if self.draining:
+                    continue  # a swap-seam preempt: drain at the loop top
                 self._admit_arrived(now, realtime)
                 if not self._inflight:
                     if not self._queue:
@@ -575,6 +705,12 @@ class CaptionService:
                 "pending": len(self._queue),
                 "inflight": len(self._inflight),
                 "slo": self.slo_snapshot(),
+                # param-version attribution: which version was serving at
+                # the drain, and the recent swap arcs — the fleet merge
+                # (obs/fleet.py) pins a reward/SLO regression to these
+                "param_version": self.param_version,
+                "param_swaps": len(self._swap_history),
+                "swap_history": self._swap_history[-8:],
             }
         }
         fields = dict(
@@ -623,6 +759,7 @@ class CaptionService:
             (self.bank.mem, self.bank.proj, self.bank.mask),
             np.zeros((B, self.table_width), np.int32),
             np.zeros((B,), np.int32), perm, perm, np.int32(B), self._state,
+            np.ones((B,), bool),
         )
 
     # ---- admission ----------------------------------------------------------
@@ -674,6 +811,7 @@ class CaptionService:
             ticket = self._tickets[req.req_id]
             ticket.t_submit = ticket.t_submit or req.arrival_s
             ticket.t_admit = t_admit
+            ticket.param_version = self.param_version
             enc_i = jax.tree.map(lambda x: x[i:i + 1], enc)
             pages = self.bank.alloc(req.req_id, m_len)
             self.bank.store(
@@ -814,11 +952,25 @@ class CaptionService:
             ts = jnp.minimum(t_b + jnp.arange(S), T - 1)
             return jax.vmap(step_noise)(ts)
 
-        def stride(params, pools, table, lens, perm, inv, n_active, state):
+        def stride(params, pools, table, lens, perm, inv, n_active, state,
+                   step_mask):
+            """One S-step stride over the lanes ``step_mask`` selects.
+
+            ``step_mask`` [B] (slot order) freezes the lanes it excludes:
+            they are treated as finished for the decode, their state leaves
+            select back to the pre-stride values, and their ``t_local``
+            does not advance — so their RNG streams resume exactly where
+            they paused. A hot param swap runs one stride per live version
+            with that version's lanes masked in; the all-True mask is the
+            single-version case and computes bit-identically to an unmasked
+            stride (``where(True, new, old) == new``)."""
             carry, token, finished, t_local, keys = state
             take1 = lambda x: jnp.take(x, perm, axis=1)  # noqa: E731
             carry_c = jax.tree.map(take1, carry)
             token_c, fin_c = take1(token), take1(finished)
+            mask_c = jnp.take(step_mask, perm)
+            carry_c0, token_c0, fin_c0 = carry_c, token_c, fin_c
+            fin_c = fin_c | ~mask_c[None, :]
             t_c = jnp.take(t_local, perm)
             keys_c = jnp.take(keys, perm, axis=0)
             mem_pool, proj_pool, mask_pool = pools
@@ -880,12 +1032,24 @@ class CaptionService:
                     step, (carry_c, token_c, fin_c), jnp.arange(S)
                 )
 
+            # frozen-lane select-back: both decode paths advance carry for
+            # rows they treat as finished (the scan's lane step computes
+            # every column), so lanes outside the mask restore their
+            # pre-stride state bit-exactly — a masked-out lane's stream is
+            # untouched, not merely ignored
+            def sel(new, old):
+                m = mask_c.reshape((1, -1) + (1,) * (new.ndim - 2))
+                return jnp.where(m, new, old)
+
+            carry_c = jax.tree.map(sel, carry_c, carry_c0)
+            token_c = sel(token_c, token_c0)
+            fin_c = sel(fin_c, fin_c0)
             back1 = lambda x: jnp.take(x, inv, axis=1)  # noqa: E731
             new_state = (
                 jax.tree.map(back1, carry_c),
                 back1(token_c),
                 back1(fin_c),
-                t_local + S,
+                t_local + S * step_mask.astype(jnp.int32),
                 keys,
             )
             return new_state, jnp.take(toks, inv, axis=2), jnp.take(
@@ -911,33 +1075,64 @@ class CaptionService:
             owners[slot] = ticket.req.req_id
             lens[slot] = self.bank.length(ticket.req.req_id)
         table = self.bank.table(owners, self.table_width)
-        with obs.span("serving.stride", active=len(active)):
+        # group active lanes by admission-pinned param version: one stride
+        # dispatch per LIVE version, each under that version's params with
+        # the other versions' lanes frozen (step_mask). The common single-
+        # version case is exactly the old one-dispatch stride (all-True
+        # mask); across a hot swap the groups share the lane state and the
+        # per-lane RNG streams stay untouched, so every request remains
+        # bit-identical to its offline decode under its pinned version.
+        by_ver: dict[int, list[int]] = {}
+        for slot in active:
+            by_ver.setdefault(
+                self._inflight[slot].param_version, []
+            ).append(slot)
+        versions = sorted(by_ver)
+        if len(versions) <= 1:
+            masks = [np.ones((self.B,), bool)]
+        else:
+            masks = []
+            for v in versions:
+                m = np.zeros((self.B,), bool)
+                m[by_ver[v]] = True
+                masks.append(m)
+        with obs.span(
+            "serving.stride", active=len(active), versions=len(versions)
+        ):
             dev = jax.device_put(
-                (table, lens, perm, inv, np.int32(len(active)))
+                (table, lens, perm, inv, np.int32(len(active)),
+                 tuple(masks))
             )
-            self._state, toks, lps = self._stride_fn(
-                self.params,
-                (self.bank.mem, self.bank.proj, self.bank.mask),
-                *dev, self._state,
-            )
+            table_d, lens_d, perm_d, inv_d, n_d, masks_d = dev
+            outs = []
+            for v, mask_d in zip(versions, masks_d):
+                self._state, toks, lps = self._stride_fn(
+                    self._params_for(v),
+                    (self.bank.mem, self.bank.proj, self.bank.mask),
+                    table_d, lens_d, perm_d, inv_d, n_d, self._state,
+                    mask_d,
+                )
+                outs.append((toks, lps))
             # the per-stride sync point: ONE explicit readback of the small
             # host-facing outputs (module docstring)
-            toks_np, lps_np, fin_np = jax.device_get(
-                (toks, lps, self._state[2])
+            outs_np, fin_np = jax.device_get(
+                (tuple(outs), self._state[2])
             )
         report.strides += 1
         obs.counter("serving.strides").inc()
         obs.counter("flops.serving.stride").inc(
             len(active) * self.G * self.S * self._tok_flops
         )
-        for slot in active:
-            ticket = self._inflight[slot]
-            n = min(self.S, self.T - ticket.t)
-            ticket.tok[:, ticket.t:ticket.t + n] = toks_np[:n, :, slot].T
-            ticket.lp[:, ticket.t:ticket.t + n] = lps_np[:n, :, slot].T
-            ticket.t += n
-            if bool(fin_np[:, slot].all()) or ticket.t >= self.T:
-                self._complete(ticket, report, now)
+        for v, (toks_np, lps_np) in zip(versions, outs_np):
+            for slot in by_ver[v]:
+                ticket = self._inflight[slot]
+                n = min(self.S, self.T - ticket.t)
+                ticket.tok[:, ticket.t:ticket.t + n] = toks_np[:n, :, slot].T
+                ticket.lp[:, ticket.t:ticket.t + n] = lps_np[:n, :, slot].T
+                ticket.t += n
+                if bool(fin_np[:, slot].all()) or ticket.t >= self.T:
+                    self._complete(ticket, report, now)
+        self._retire_versions()
 
     def _complete(self, ticket: _Ticket, report: ServeReport, now) -> None:
         with obs.span("serving.detok", req=ticket.req.req_id):
@@ -966,7 +1161,7 @@ class CaptionService:
             "detok": detok_s,
         }
         latency = max(t_done - ticket.t_submit, 0.0)
-        report.results[ticket.req.req_id] = CaptionResult(
+        result = CaptionResult(
             req_id=ticket.req.req_id,
             tokens=ticket.tok,
             logprobs=ticket.lp,
@@ -975,7 +1170,9 @@ class CaptionService:
             caption=caption,
             latency_s=latency,
             phases=phases,
+            param_version=ticket.param_version,
         )
+        report.results[ticket.req.req_id] = result
         obs.counter("serving.requests_completed").inc()
         obs.gauge("serving.slots_in_use").set(len(self._inflight))
         obs.gauge("serving.pages_in_use").set(self.bank.pages_in_use)
@@ -990,10 +1187,17 @@ class CaptionService:
             self._slo.observe(latency, t_done)
         obs.event(
             "serving_request", req=ticket.req.req_id, latency_s=latency,
-            best_lane=best, steps=ticket.t, **{
+            best_lane=best, steps=ticket.t,
+            param_version=ticket.param_version, **{
                 f"{k}_s": v for k, v in phases.items()
             },
         )
+        if self._feedback is not None:
+            # serving-as-actor feedback capture: the completed request's
+            # (greedy + sampled lanes, logprobs, seed, pinned version) go
+            # to the online learner — tok/lp are already host arrays, so
+            # this dispatches nothing on device
+            self._feedback(ticket.req, result, ticket.param_version)
 
     # ---- drain persistence --------------------------------------------------
 

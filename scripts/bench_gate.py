@@ -145,6 +145,8 @@ def check_file(path: str) -> list[str]:
             )
     if name == "BENCH_RL_ASYNC.json":
         _check_rl_async(path, data, errors)
+    if name == "BENCH_RL_ONLINE.json":
+        _check_rl_online(path, data, errors)
     _walk(path, data, errors)
     return errors
 
@@ -176,6 +178,37 @@ def _check_rl_async(path: str, data: dict, errors: list[str]) -> None:
         errors.append(
             f"{path}: decoupled rung occupancy must carry actor + learner"
         )
+
+
+def _check_rl_online(path: str, data: dict, errors: list[str]) -> None:
+    """The serving-as-actor ledger's own promises: the swap-parity block
+    carries the hot-swap pins (tokens vs fused_decode, full bit-exact
+    fresh-service replay, straddled live traffic, two-run determinism —
+    _check_parity then enforces they are true), and the online rung
+    carries the closed-loop evidence (update/swap counters, staleness
+    drop ledger, reward trend over the seeded trace)."""
+    parity = data.get("parity")
+    if not isinstance(parity, dict):
+        errors.append(f"{path}: missing the swap-parity block")
+    else:
+        for k in ("swap_parity_tokens_bit_exact",
+                  "swap_parity_replay_bit_exact",
+                  "swap_straddled_live_traffic",
+                  "two_runs_bit_identical_params"):
+            if k not in parity:
+                errors.append(f"{path}: parity block missing {k!r}")
+    rung = (data.get("rungs") or {}).get("online")
+    if not isinstance(rung, dict):
+        errors.append(f"{path}: missing the 'online' rung")
+        return
+    if not isinstance(rung.get("learner_updates"), int):
+        errors.append(f"{path}: online rung missing learner_updates")
+    if not isinstance(rung.get("dropped_stale"), int):
+        errors.append(f"{path}: online rung missing dropped_stale")
+    if not isinstance(rung.get("staleness_histogram"), dict):
+        errors.append(f"{path}: online rung missing staleness_histogram")
+    if not isinstance(rung.get("reward_trend"), list):
+        errors.append(f"{path}: online rung missing reward_trend")
 
 
 def main(argv: list[str]) -> int:

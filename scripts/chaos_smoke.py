@@ -1,16 +1,27 @@
-"""Elastic shrink->regrow chaos smoke: the lint-gate resilience check.
+"""Elastic shrink->regrow + serving mid-swap chaos smoke: the lint-gate
+resilience check.
 
-Seeded end-to-end scenario on 2 simulated hosts with tiny dims (CPU,
-~half a minute): kill host 1 mid-RL-epoch (``partial_preempt``), let the
-survivor drain to a degraded 1-device mesh, then re-admit the recovered
-host through the ``health.rejoin`` marker seam (``host_rejoin``) and
-finish the budget on the FULL mesh. Asserts the trajectory invariants
-the chaos tests pin in depth:
+Two seeded end-to-end scenarios with tiny dims (CPU, ~half a minute):
 
-- both faults fired, in order;
-- the run ends on the full 2-device mesh (regrow admitted, none refused);
-- the step clock is contiguous through BOTH seams (no rewind, no skip);
-- rewards, losses, and final params are finite.
+1. Elastic: on 2 simulated hosts, kill host 1 mid-RL-epoch
+   (``partial_preempt``), let the survivor drain to a degraded 1-device
+   mesh, then re-admit the recovered host through the ``health.rejoin``
+   marker seam (``host_rejoin``) and finish the budget on the FULL mesh.
+   Asserts the trajectory invariants the chaos tests pin in depth:
+
+   - both faults fired, in order;
+   - the run ends on the full 2-device mesh (regrow admitted, none
+     refused);
+   - the step clock is contiguous through BOTH seams (no rewind, no
+     skip);
+   - rewards, losses, and final params are finite.
+
+2. Serving hot-swap: a ``param_swap`` fault preempts a live
+   :class:`CaptionService` EXACTLY mid-swap (publish staged, application
+   interrupted). The swap must be fully applied or fully refused — never
+   torn: active version unchanged, pending publish cleared, every served
+   request still pinned to v0, and the drained queue replays
+   bit-identically under the old params.
 
 Run by scripts/lint.sh (JAX_PLATFORMS=cpu). Exits non-zero on any
 violated invariant.
@@ -51,8 +62,90 @@ from cst_captioning_tpu.data import (  # noqa: E402
     CaptionDataset,
     make_synthetic_dataset,
 )
+from cst_captioning_tpu.models import CaptionModel  # noqa: E402
 from cst_captioning_tpu.resilience import Fault, FaultPlan  # noqa: E402
+from cst_captioning_tpu.serving import (  # noqa: E402
+    CaptionService,
+    ClipRequest,
+    load_snapshot,
+)
 from cst_captioning_tpu.train.trainer import Trainer  # noqa: E402
+
+
+def serving_param_swap_scenario() -> None:
+    """Seeded mid-swap preempt on a live service: fully refused, never
+    torn, drained queue replays bit-identically under the old params."""
+    from cst_captioning_tpu.config.config import EOS_ID
+
+    import jax.numpy as jnp
+
+    cfg = ModelConfig(
+        vocab_size=61, modalities=(("resnet", 8),), d_embed=12, d_hidden=12,
+        d_att=6, encoder="temporal_attention", dropout=0.0, max_len=10,
+        max_frames=6, dtype="float32",
+    )
+    model = CaptionModel(cfg)
+    feats0 = {"resnet": jnp.zeros((1, 6, 8), jnp.float32)}
+    masks0 = {"resnet": jnp.ones((1, 6), jnp.float32)}
+    params = model.init(
+        jax.random.key(0), feats0, masks0, jnp.zeros((1, 10), jnp.int32)
+    )
+    bias = params["params"]["cell"]["out_proj"]["bias"]
+    params["params"]["cell"]["out_proj"]["bias"] = bias.at[EOS_ID].add(2.0)
+    p2 = jax.tree.map(lambda x: x, params)
+    bias = p2["params"]["cell"]["out_proj"]["bias"]
+    p2["params"]["cell"]["out_proj"]["bias"] = bias.at[5].add(3.0)
+
+    def requests():
+        out = []
+        for i, F in enumerate((2, 6, 4, 6, 3)):
+            rng = np.random.default_rng(200 + i)
+            out.append(ClipRequest(
+                req_id=f"c{i}",
+                feats={"resnet": rng.normal(size=(F, 8)).astype(np.float32)},
+                masks={"resnet": np.ones((F,), np.float32)},
+                seed=300 + i,
+            ))
+        return out
+
+    def service():
+        return CaptionService(model, params, capacity=2, num_rollouts=2,
+                              stride=4, frame_bucket=2)
+
+    base = service().serve(requests())
+    with tempfile.TemporaryDirectory() as root:
+        snap = os.path.join(root, "swapdrain")
+        plan = FaultPlan([Fault("serving.param_swap", "param_swap", at=0)])
+        svc = service()
+        published = []
+
+        def feedback(req, result, version):
+            if not published:
+                published.append(svc.publish_params(p2, version=1))
+
+        svc._feedback = feedback
+        with plan.activate():
+            drained = svc.serve(requests(), snapshot_dir=snap)
+        assert plan.fired and plan.fired[0]["kind"] == "param_swap", plan.fired
+        assert drained.drained and drained.drain_reason == "chaos_param_swap"
+        # fully refused: no version change, no torn half-applied state
+        assert svc.param_version == 0 and svc._pending_publish is None
+        assert svc._swap_history == [] and svc._old_params == {}
+        assert all(r.param_version == 0 for r in drained.results.values())
+        replay = service().serve(load_snapshot(snap))
+        union = dict(drained.results)
+        union.update(replay.results)
+        assert set(union) == set(base.results), sorted(union)
+        for rid, res in base.results.items():
+            np.testing.assert_array_equal(union[rid].tokens, res.tokens, rid)
+            np.testing.assert_array_equal(
+                union[rid].logprobs, res.logprobs, rid
+            )
+    print(
+        "chaos smoke OK: mid-swap preempt fully refused (never torn), "
+        f"{len(drained.results)} served + {len(replay.results)} replayed "
+        "bit-identically under v0"
+    )
 
 
 def main() -> int:
@@ -136,6 +229,7 @@ def main() -> int:
         "chaos smoke OK: shrink->regrow finished on the full mesh, "
         f"{len(steps)} contiguous RL steps, finite dynamics"
     )
+    serving_param_swap_scenario()
     return 0
 
 

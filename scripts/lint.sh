@@ -8,7 +8,7 @@
 # suppression (--check-stale), or an UNFIXED autofixable finding
 # (--fix-check: the repair is mechanical, so run
 # `python -m cst_captioning_tpu.tools.graftlint --fix` and commit), or the
-# two-pass lint exceeding its 2 s budget; (b) any file that doesn't
+# two-pass lint exceeding its 3 s budget; (b) any file that doesn't
 # byte-compile; (c) the obs_report / decode / sanitizer smokes failing.
 # tier-1 runs the same graftlint check via tests/test_graftlint.py
 # (test_repo_is_graftlint_clean), so CI cannot drift from this script.
@@ -26,20 +26,24 @@ python -m cst_captioning_tpu.tools.graftlint --changed-only --timings
 # warm; now carrying the per-function axis environments, donation facts,
 # and the shape/dtype/sharding environments that power GL016–GL020),
 # pass 2 runs the per-file + interprocedural rules. --timings prints the
-# per-pass line; --budget asserts index+rules stay under 2 s. This
+# per-pass line; --budget asserts index+rules stay under 3 s (bumped
+# from 2 s as the tree grew past ~145 files; still catches a rule or
+# cache regression, which costs 10x, not 10%). This
 # full-tree line stays the authoritative gate — --changed-only above is
 # only the fast path.
 python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_comms.py bench_decode.py \
-    bench_eval.py bench_recipe.py bench_rl_async.py bench_serving.py \
-    --fix-check --check-stale --timings --budget 2
+    bench_eval.py bench_recipe.py bench_rl_async.py bench_rl_online.py \
+    bench_serving.py \
+    --fix-check --check-stale --timings --budget 3
 
 # catches syntax errors in files graftlint may not reach (non-.py-suffixed
 # entry points aside, this is the whole tree)
 python -m compileall -q cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_comms.py bench_decode.py \
-    bench_eval.py bench_recipe.py bench_rl_async.py bench_serving.py
+    bench_eval.py bench_recipe.py bench_rl_async.py bench_rl_online.py \
+    bench_serving.py
 
 # obs_report smoke check: the report CLI must aggregate a known-good run dir
 # without a jax import or backend init (it is part of the operator loop for
@@ -88,6 +92,14 @@ JAX_PLATFORMS=cpu python bench_serving.py --smoke > /dev/null
 # gate inside (ring replay bit-identical to the sync schedule: params AND
 # every scored token row) — README "Decoupled actor/learner RL"
 JAX_PLATFORMS=cpu python bench_rl_async.py --smoke > /dev/null
+
+# online-RL smoke: tiny-dims CPU run of the serving-as-actor closed loop
+# (frozen vs online rung over the same seeded trace) with the swap-parity
+# gate inside (every request token-bit-exact vs fused_decode under its
+# admission-pinned version, fresh-service replay fully bit-exact, two
+# seeded runs -> bit-identical learner params) — README "Online RL from
+# served traffic"
+JAX_PLATFORMS=cpu python bench_rl_online.py --smoke > /dev/null
 
 # eval fast-path smoke: tiny-dims CPU run of the serial/pipelined/NPAD
 # eval ladder with the in-run parity gate inside (lane beam bit-exact vs

@@ -1,0 +1,105 @@
+"""scripts/bench_gate.py negative tests: the gate must actually FAIL on
+the violations it promises to catch — a parity bool silently flipped
+false, a bench emitting a new schema without its required blocks, a torn
+file from a killed run. (lint.sh runs the gate on the committed tree,
+which only proves the green path; these prove the red path.)
+
+No jax import — the gate is plain-JSON tooling and must stay runnable on
+a box with nothing but the repo.
+"""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_gate", os.path.join(REPO, "scripts", "bench_gate.py")
+)
+bench_gate = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_gate)
+
+
+def _good_rl_online() -> dict:
+    """A minimal BENCH_RL_ONLINE.json the gate accepts — mirrors the
+    schema bench_rl_online.py writes."""
+    return {
+        "metric": "online_rl_requests_per_s",
+        "device_kind": "cpu",
+        "note": "non-TPU run: rerun on TPU for throughput acceptance",
+        "rungs": {
+            "frozen": {"requests_per_s": 10.0, "reward_mean": 1.0},
+            "online": {
+                "requests_per_s": 5.0,
+                "learner_updates": 8,
+                "param_swaps": 4,
+                "dropped_stale": 0,
+                "staleness_histogram": {"0": 1, "1": 4},
+                "reward_trend": [0.5, 0.75],
+            },
+        },
+        "parity": {
+            "swap_parity_tokens_bit_exact": True,
+            "swap_parity_replay_bit_exact": True,
+            "swap_parity_logprobs_ulp_bounded_vs_fused": True,
+            "swap_straddled_live_traffic": True,
+            "two_runs_bit_identical_params": True,
+            "versions_straddled": 2,
+            "requests_checked": 16,
+        },
+        "parity_ok": True,
+    }
+
+
+def _run(tmp_path, data) -> int:
+    if not isinstance(data, str):
+        data = json.dumps(data)
+    (tmp_path / "BENCH_RL_ONLINE.json").write_text(data)
+    return bench_gate.main(["bench_gate", str(tmp_path)])
+
+
+def test_gate_accepts_good_rl_online_ledger(tmp_path):
+    assert _run(tmp_path, _good_rl_online()) == 0
+
+
+def test_gate_rejects_false_parity_bool(tmp_path):
+    bad = _good_rl_online()
+    bad["parity"]["swap_parity_replay_bit_exact"] = False
+    assert _run(tmp_path, bad) == 1
+
+
+def test_gate_rejects_missing_swap_parity_pin(tmp_path):
+    bad = _good_rl_online()
+    del bad["parity"]["two_runs_bit_identical_params"]
+    assert _run(tmp_path, bad) == 1
+
+
+def test_gate_rejects_missing_online_rung_evidence(tmp_path):
+    for field in ("learner_updates", "dropped_stale",
+                  "staleness_histogram", "reward_trend"):
+        bad = _good_rl_online()
+        del bad["rungs"]["online"][field]
+        assert _run(tmp_path, bad) == 1, field
+
+
+def test_gate_rejects_missing_online_rung(tmp_path):
+    bad = _good_rl_online()
+    del bad["rungs"]["online"]
+    assert _run(tmp_path, bad) == 1
+
+
+def test_gate_rejects_nontpu_without_note(tmp_path):
+    bad = _good_rl_online()
+    bad["note"] = None
+    assert _run(tmp_path, bad) == 1
+
+
+def test_gate_rejects_torn_json(tmp_path):
+    assert _run(tmp_path, '{"metric": "online_rl_requests_per_s", "par') == 1
+
+
+def test_gate_on_committed_tree_is_clean():
+    """The committed BENCH_*.json set keeps its own promises — the exact
+    invocation scripts/lint.sh runs."""
+    assert bench_gate.main(["bench_gate", REPO]) == 0

@@ -1,0 +1,260 @@
+"""Online RL from served traffic (rl/online.py): feedback capture, the
+drop-and-COUNT staleness gate, the closed serve->update->publish loop, and
+the acceptance pin — two seeded online runs over the same trace and swap
+schedule produce BIT-identical learner params.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config.config import (
+    EOS_ID,
+    ModelConfig,
+    RLConfig,
+    TrainConfig,
+)
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.rl import OnlineSCSTTrainer
+from cst_captioning_tpu.serving import CaptionService, ClipRequest
+from cst_captioning_tpu.train import create_train_state, make_optimizer
+
+MODAL = (("resnet", 8),)
+T = 8
+MAX_F = 5
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab_size=31, modalities=MODAL, d_embed=12, d_hidden=12, d_att=6,
+        encoder="temporal_attention", dropout=0.0, max_len=T,
+        max_frames=MAX_F,
+        dtype="float32",
+    )
+    model = CaptionModel(cfg)
+    rng = np.random.default_rng(0)
+    feats = {"resnet": jnp.asarray(rng.normal(size=(2, MAX_F, 8)),
+                                   jnp.float32)}
+    masks = {"resnet": jnp.ones((2, MAX_F), jnp.float32)}
+    labels = jnp.asarray(rng.integers(4, 31, size=(2, T)), jnp.int32)
+    tx = make_optimizer(TrainConfig(lr=5e-2, grad_clip=5.0), 10)
+    state = create_train_state(model, tx, (feats, masks, labels), seed=1)
+    # EOS-biased params so caption lengths vary (continuous batching, and
+    # lanes freeing at different strides straddle the swaps)
+    p = jax.tree.map(lambda x: x, state.params)
+    bias = p["params"]["cell"]["out_proj"]["bias"]
+    p["params"]["cell"]["out_proj"]["bias"] = bias.at[EOS_ID].add(2.0)
+    return model, state.replace(params=p)
+
+
+def _rl_cfg(**kw):
+    base = dict(
+        enabled=True, num_rollouts=2, baseline="greedy", lr=5e-2,
+        rollout_depth=1, staleness_bound=8, online_batch_size=2,
+        swap_every=1,
+    )
+    base.update(kw)
+    return RLConfig(**base)
+
+
+class TokenReward:
+    """Rigged consensus scorer: +1 per occurrence of a target token."""
+
+    def __init__(self, target: int):
+        self.target = target
+        self.calls = 0
+
+    def __call__(self, video_ids, rows):
+        self.calls += 1
+        rows = np.asarray(rows)
+        return (rows == self.target).sum(axis=1).astype(np.float32)
+
+
+def _requests(n=6, seed0=1000):
+    out = []
+    frames = (1, 5, 3, 5, 2, 4, 1, 5)
+    for i in range(n):
+        rng = np.random.default_rng(100 + i)
+        F = frames[i % len(frames)]
+        out.append(ClipRequest(
+            req_id=f"r{i}",
+            feats={"resnet": rng.normal(size=(F, 8)).astype(np.float32)},
+            masks={"resnet": np.ones((F,), np.float32)},
+            seed=seed0 + i,
+        ))
+    return out
+
+
+def _run_loop(model, state0, cfg, n=6):
+    """One seeded online run: serve a fixed trace with the learner attached;
+    returns (trainer, service, report)."""
+    trainer = OnlineSCSTTrainer(model, TokenReward(3), cfg, state0)
+    svc = CaptionService(model, state0.params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=1)
+    trainer.attach(svc)
+    report = svc.serve(_requests(n))
+    trainer.flush()
+    return trainer, svc, report
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---- the closed loop --------------------------------------------------------
+
+
+def test_online_loop_captures_updates_and_publishes(setup):
+    """6 served requests at online_batch_size 2 become 3 learner updates;
+    each update publishes (swap_every=1) and the service's active version
+    tracks the learner counter. The reward-trend ledger carries one row
+    per applied update."""
+    model, state0 = setup
+    trainer, svc, report = _run_loop(model, state0, _rl_cfg())
+    assert report.completed == 6 and not report.drained
+    assert trainer.version == 3 == trainer.last_applied
+    assert trainer.last_dropped == 0
+    assert trainer.pending_captures == 0
+    # the final publish applied at the loop's last stride boundary
+    assert svc.param_version == 3
+    assert len(svc._swap_history) == 3
+    assert [h["version"] for h in svc._swap_history] == [1, 2, 3]
+    # reward trend: one metrics row per update, version-stamped
+    assert [m["param_version"] for m in trainer.history] == [1, 2, 3]
+    assert all("reward_mean" in m for m in trainer.history)
+    # the learner actually moved the params
+    assert any(
+        not np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(state0.params),
+                        jax.tree_util.tree_leaves(trainer.state.params))
+    )
+    # served results pin the version that decoded them, monotonically
+    versions = [report.results[f"r{i}"].param_version for i in range(6)]
+    assert versions[0] == 0 and max(versions) >= 1
+    assert versions == sorted(versions)
+
+
+def test_online_partial_buffer_waits(setup):
+    """A trailing capture short of online_batch_size stays buffered (batch
+    shapes through the ring are constant) and is visible via
+    pending_captures; flush() does not fabricate a short batch."""
+    model, state0 = setup
+    trainer, svc, report = _run_loop(model, state0, _rl_cfg(), n=5)
+    assert report.completed == 5
+    assert trainer.version == 2 and trainer.pending_captures == 1
+    assert trainer.flush() == 0
+    assert trainer.pending_captures == 1
+
+
+def _capture(trainer, version, rid, seed=0):
+    """Feed one synthetic completed request through the feedback hook:
+    exactly what the service hands over at the stride seam (1+K host
+    token/logprob rows), with a chosen admission-pinned version."""
+    from types import SimpleNamespace
+
+    rng = np.random.default_rng(seed)
+    req = ClipRequest(
+        req_id=rid,
+        feats={"resnet": rng.normal(size=(3, 8)).astype(np.float32)},
+        masks={"resnet": np.ones((3,), np.float32)},
+        seed=seed,
+    )
+    result = SimpleNamespace(
+        tokens=rng.integers(4, 31, size=(3, T)).astype(np.int32),
+        logprobs=rng.normal(size=(3, T)).astype(np.float32) - 2.0,
+    )
+    trainer.on_result(req, result, version)
+
+
+def test_online_staleness_drop_and_count(setup):
+    """Captures admitted under a version the learner has since advanced
+    past staleness_bound are DROPPED and counted — never re-decoded (served
+    tokens are ground truth). Applied + dropped accounts for every consumed
+    batch, and the staleness ledger matches."""
+    model, state0 = setup
+    trainer = OnlineSCSTTrainer(
+        model, TokenReward(3), _rl_cfg(staleness_bound=0), state0
+    )
+    # batch 1: two v0 captures at learner v0 -> stale 0 -> applied, v1
+    _capture(trainer, 0, "a0", seed=1)
+    _capture(trainer, 0, "a1", seed=2)
+    assert trainer.version == 1 and trainer.last_dropped == 0
+    # batch 2: two captures SERVED before that swap (still stamped v0)
+    # -> stale 1 > bound 0 -> dropped-and-counted, learner unchanged
+    _capture(trainer, 0, "b0", seed=3)
+    _capture(trainer, 0, "b1", seed=4)
+    assert trainer.version == 1 and trainer.last_dropped == 1
+    # batch 3: post-swap traffic (v1) applies again
+    _capture(trainer, 1, "c0", seed=5)
+    _capture(trainer, 1, "c1", seed=6)
+    assert trainer.version == 2
+    assert trainer.last_applied == 2 and trainer.last_dropped == 1
+    assert trainer.last_staleness == {0: 2, 1: 1}
+    # a mixed-version batch is as stale as its OLDEST capture
+    _capture(trainer, 0, "d0", seed=7)
+    _capture(trainer, 2, "d1", seed=8)
+    assert trainer.last_dropped == 2 and trainer.version == 2
+    assert trainer.last_staleness == {0: 2, 1: 1, 2: 1}
+
+
+def test_online_two_runs_bit_identical(setup):
+    """THE determinism pin: the whole loop (capture order, batch forming,
+    staleness drops, updates, publishes) runs on the serving thread as a
+    deterministic function of (trace, swap schedule) — two seeded runs end
+    with bit-identical learner params and identical ledgers."""
+    model, state0 = setup
+    cfg = _rl_cfg(staleness_bound=1)
+    t1, s1, _ = _run_loop(model, state0, cfg)
+    t2, s2, _ = _run_loop(model, state0, cfg)
+    assert t1.version == t2.version
+    assert t1.last_applied == t2.last_applied
+    assert t1.last_dropped == t2.last_dropped
+    assert t1.last_staleness == t2.last_staleness
+    assert s1.param_version == s2.param_version
+    _assert_tree_equal(t1.state.params, t2.state.params)
+    _assert_tree_equal(t1.state.opt_state, t2.state.opt_state)
+
+
+# ---- wiring guards ----------------------------------------------------------
+
+
+def test_attach_rejects_donating_learner(setup):
+    model, state0 = setup
+    trainer = OnlineSCSTTrainer(
+        model, TokenReward(3), _rl_cfg(), state0, donate=True
+    )
+    svc = CaptionService(model, state0.params, capacity=2, num_rollouts=2)
+    with pytest.raises(ValueError, match="donate"):
+        trainer.attach(svc)
+
+
+def test_attach_rejects_version_mismatch(setup):
+    model, state0 = setup
+    trainer = OnlineSCSTTrainer(model, TokenReward(3), _rl_cfg(), state0)
+    trainer.version = 2  # a learner mid-run against a fresh service
+    svc = CaptionService(model, state0.params, capacity=2, num_rollouts=2)
+    with pytest.raises(ValueError, match="version"):
+        trainer.attach(svc)
+
+
+def test_capture_rejects_lane_mismatch(setup):
+    """A service decoding a different 1+K than the learner's K is a wiring
+    error the first capture rejects loudly."""
+    model, state0 = setup
+    trainer = OnlineSCSTTrainer(model, TokenReward(3), _rl_cfg(), state0)
+    svc = CaptionService(model, state0.params, capacity=2, num_rollouts=1)
+    trainer.attach(svc)
+    with pytest.raises(ValueError, match="lanes"):
+        svc.serve(_requests(1))
+
+
+def test_online_config_validation():
+    from cst_captioning_tpu.config.config import ExperimentConfig
+
+    with pytest.raises(ValueError, match="online_batch_size"):
+        ExperimentConfig(rl=RLConfig(online_batch_size=0))
+    with pytest.raises(ValueError, match="swap_every"):
+        ExperimentConfig(rl=RLConfig(swap_every=0))

@@ -525,6 +525,8 @@ def test_serving_drain_dumps_postmortem_with_slo_snapshot(setup, tmp_path):
     sv = pm["registry"]["serving"]
     assert sv["drain_reason"] == "chaos_serving_preempt"
     assert sv["slo"] is not None and sv["slo"]["target_s"] == 30.0
+    # the bundle names the param version that served (fleet attribution)
+    assert sv["param_version"] == 0 and sv["param_swaps"] == 0
     snap = obs_metrics.snapshot()
     assert snap["counters"].get("serving.drain_postmortem_error") is None
 
@@ -608,3 +610,221 @@ def test_service_set_slo_gauges_and_snapshot(setup):
     assert s["burn_rate"] == {"60s": 0.0, "600s": 0.0}
     svc.set_slo(0.0)
     assert svc.slo_snapshot() is None
+
+
+# ---- drain-free hot param swap (online RL feedback loop) --------------------
+
+
+def _perturbed(params, tok=5, delta=3.0):
+    """A second param version whose captions visibly differ: copy the tree
+    containers (leaves shared) and raise one output-bias logit."""
+    p2 = jax.tree.map(lambda x: x, params)
+    bias = p2["params"]["cell"]["out_proj"]["bias"]
+    p2["params"]["cell"]["out_proj"]["bias"] = bias.at[tok].add(delta)
+    return p2
+
+
+def test_hot_param_swap_midflight_parity(setup):
+    """THE swap acceptance pin: a publish landing while requests are in
+    flight applies at a stride boundary; every request — admitted before OR
+    after the swap — is token- and logprob-bit-identical to the offline
+    fused decode under its admission-pinned params. The straddle window
+    exercises mixed-version strides (one masked dispatch per live
+    version)."""
+    model, params = setup
+    p2 = _perturbed(params)
+    reqs = _requests()
+    svc = CaptionService(model, params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    svc.set_slo(30.0)
+    published = []
+
+    def feedback(req, result, version):
+        if not published:
+            published.append(svc.publish_params(p2, version=1))
+
+    svc._feedback = feedback
+    report = svc.serve(reqs)
+    assert published == [True]
+    assert report.completed == len(reqs) and not report.drained
+    assert svc.param_version == 1
+    assert len(svc._swap_history) == 1
+    by_ver = {0: [], 1: []}
+    for req in reqs:
+        by_ver[report.results[req.req_id].param_version].append(req)
+    # the swap genuinely straddled live traffic
+    assert by_ver[0] and by_ver[1]
+    _assert_parity(model, params, report, by_ver[0])
+    _assert_parity(model, p2, report, by_ver[1])
+    # the two versions really produce different captions (non-vacuous)
+    assert any(
+        not np.array_equal(_offline(model, params, r)[0],
+                           _offline(model, p2, r)[0])
+        for r in by_ver[1]
+    )
+    # the outgoing tree was retired once its last pinned lane completed
+    assert svc._old_params == {}
+    # slo snapshot names the active version
+    assert svc.slo_snapshot()["param_version"] == 1
+    # a replayed/stale publish is refused, not applied
+    assert not svc.publish_params(params, version=1)
+    assert svc.param_version == 1 and svc._pending_publish is None
+
+
+def test_param_swap_chaos_preempt_refuses_never_tears(setup, tmp_path):
+    """The seeded ``param_swap`` fault preempts EXACTLY mid-swap (after the
+    publish staged, before application): the swap must be fully refused —
+    active version unchanged, pending publish cleared — and the drained
+    queue replays bit-identically under the OLD params."""
+    model, params = setup
+    p2 = _perturbed(params)
+    reqs = _requests()
+    base = CaptionService(model, params, capacity=2, num_rollouts=2,
+                          stride=4, frame_bucket=2).serve(reqs)
+
+    snap = str(tmp_path / "swapdrain")
+    plan = FaultPlan([Fault("serving.param_swap", "param_swap", at=0)])
+    svc = CaptionService(model, params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    published = []
+
+    def feedback(req, result, version):
+        if not published:
+            published.append(svc.publish_params(p2, version=1))
+
+    svc._feedback = feedback
+    with plan.activate():
+        drained = svc.serve(_requests(), snapshot_dir=snap)
+    assert plan.fired and plan.fired[0]["kind"] == "param_swap"
+    assert drained.drained and drained.drain_reason == "chaos_param_swap"
+    # fully refused: no version change, no torn half-applied state
+    assert svc.param_version == 0 and svc._pending_publish is None
+    assert svc._swap_history == [] and svc._old_params == {}
+    # everything served (before and during the drain) ran under v0
+    assert all(
+        r.param_version == 0 for r in drained.results.values()
+    )
+    restored = load_snapshot(snap)
+    replay = CaptionService(model, params, capacity=2, num_rollouts=2,
+                            stride=4, frame_bucket=2).serve(restored)
+    union = dict(drained.results)
+    union.update(replay.results)
+    assert set(union) == set(base.results)
+    for rid, res in base.results.items():
+        np.testing.assert_array_equal(union[rid].tokens, res.tokens, rid)
+        np.testing.assert_array_equal(union[rid].logprobs, res.logprobs, rid)
+
+
+def test_param_swap_obs_report_renders_versions(setup, tmp_path):
+    """An applied swap lands in the run report's serving section (version
+    gauge + swap counter) and the text rendering."""
+    from cst_captioning_tpu import obs
+    from cst_captioning_tpu.obs import metrics as obs_metrics
+    from cst_captioning_tpu.obs.report import report_run, render_report
+
+    model, params = setup
+    p2 = _perturbed(params)
+    obs_metrics.REGISTRY.reset()
+    run_dir = str(tmp_path / "obsswap")
+    obs.configure(run_dir, run="serve-swap")
+    try:
+        svc = CaptionService(model, params, capacity=2, num_rollouts=1,
+                             stride=4)
+        published = []
+
+        def feedback(req, result, version):
+            if not published:
+                published.append(svc.publish_params(p2))
+
+        svc._feedback = feedback
+        svc.serve(_requests(frames=(2, 8, 5)))
+        obs.snapshot_metrics()
+    finally:
+        obs.shutdown()
+    rep = report_run(run_dir)
+    sv = rep["serving"]
+    assert sv["param_swaps"] == 1 and sv["param_swaps_refused"] == 0
+    assert sv["param_version"] == 1.0
+    assert "param swaps: 1 applied (active v1)" in render_report(rep)
+
+
+# ---- bf16 batched-admission fallback ----------------------------------------
+
+
+def test_bf16_admission_group_falls_back_to_per_request(setup):
+    """admit_group > 1 promises row-stable grouped encodes; bf16 gemms are
+    not row-stable, so a bf16 service demotes to per-request admission
+    encode (the parity-preserving path) and counts the fallback. f32 keeps
+    the grouped path (bit-exactness pinned above)."""
+    model, params = setup
+    m_bf16 = CaptionModel(dataclasses.replace(model.cfg, dtype="bfloat16"))
+    svc = CaptionService(m_bf16, params, capacity=4, num_rollouts=1,
+                         admit_group=4)
+    assert svc.requested_admit_group == 4 and svc.admit_group == 1
+    report = svc.serve(_requests(frames=(8, 8, 8, 8), seed0=5000))
+    assert report.completed == 4
+    svc32 = CaptionService(model, params, capacity=4, num_rollouts=1,
+                           admit_group=4)
+    assert svc32.requested_admit_group == 4 and svc32.admit_group == 4
+
+
+# ---- pallas stride-kernel path: grow / snapshot-regrow ----------------------
+
+
+def test_pallas_grow_capacity_with_live_state_preserves_parity(setup):
+    """grow_capacity with live lane state on the pallas stride-kernel path
+    (kernel_block_b=1 per-row raggedness): requests admitted at the grown
+    width still decode bit-identically to the offline pallas oracle."""
+    model, params = setup
+    m_pal = CaptionModel(dataclasses.replace(
+        model.cfg, decode_impl="pallas", decode_stride=4,
+    ))
+    svc = CaptionService(m_pal, params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    r1 = svc.serve(_requests(frames=(1, 8, 3), seed0=1000))
+    assert r1.completed == 3 and svc._state is not None
+    svc.grow_capacity(4)
+    assert svc.B == 4 and len(svc._free_slots) == 4
+    second = [
+        dataclasses.replace(r, req_id="g" + r.req_id)
+        for r in _requests(frames=(8, 2, 5, 4), seed0=2000)
+    ]
+    r2 = svc.serve(second)
+    assert set(r2.results) >= {r.req_id for r in second}
+    _assert_parity(m_pal, params, r2, second)
+
+
+@pytest.mark.slow  # heaviest pallas compile chain; the grow-parity test
+#                    above keeps the pallas grow seam in tier-1
+def test_pallas_snapshot_replays_onto_regrown_service(setup, tmp_path):
+    """load_snapshot(grow_to=) on the pallas stride-kernel path: a drained
+    shard's queue replays onto a degraded-width pallas service grown back
+    to full width, bit-identical to the undrained full-width run."""
+    model, params = setup
+    m_pal = CaptionModel(dataclasses.replace(
+        model.cfg, decode_impl="pallas", decode_stride=4,
+    ))
+    reqs = _requests()
+    base = CaptionService(m_pal, params, capacity=4, num_rollouts=2,
+                          stride=4, frame_bucket=2).serve(reqs)
+
+    snap = str(tmp_path / "palregrow")
+    plan = FaultPlan([Fault("serving.step", "serving_preempt", at=3)])
+    svc = CaptionService(m_pal, params, capacity=4, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    with plan.activate():
+        drained = svc.serve(_requests(), snapshot_dir=snap)
+    assert drained.drained and drained.completed < len(reqs)
+
+    regrown = CaptionService(m_pal, params, capacity=2, num_rollouts=2,
+                             stride=4, frame_bucket=2)
+    restored = load_snapshot(snap, service=regrown, grow_to=4)
+    assert len(restored) == len(reqs) - drained.completed
+    assert regrown.B == 4 and len(regrown._free_slots) == 4
+    replay = regrown.serve(())
+    union = dict(drained.results)
+    union.update(replay.results)
+    assert set(union) == set(base.results)
+    for rid, res in base.results.items():
+        np.testing.assert_array_equal(union[rid].tokens, res.tokens, rid)
+        np.testing.assert_array_equal(union[rid].logprobs, res.logprobs, rid)
