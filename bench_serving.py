@@ -20,6 +20,16 @@ Arrival rates are CALIBRATED to the machine: the trace's mean rate is
 so the bench exercises a loaded-but-stable system everywhere instead of a
 trivially idle (or hopelessly overloaded) one on slow hosts.
 
+A ``paged_inkernel`` rung re-serves both traces through the pallas stride
+kernel's paged path (in-kernel page-table reads from the pool — no dense
+[B, W, E] bank per stride) against the same kernel on the dense-gather
+reference (``paged=False``), with an in-run token- AND logprob-bit-exact
+parity gate between the two, the per-stride bank bytes each path moves
+(obs/flops.serving_bank_bytes_per_stride: the gather pays 3x), and a
+stress config whose page pool exceeds one batch's dense-bank footprint —
+a pool the gather path refuses at construction, which the paged engine
+fills via encode-ahead staging.
+
 A parity block re-decodes sampled requests OFFLINE through
 ``decoding.fused.fused_decode`` and requires token- AND logprob-bit-exact
 agreement with the served results (the continuous engine's per-request
@@ -55,7 +65,11 @@ import time
 
 import numpy as np
 
-from cst_captioning_tpu.obs.flops import enc_and_per_tok_flops, peak_flops
+from cst_captioning_tpu.obs.flops import (
+    enc_and_per_tok_flops,
+    peak_flops,
+    serving_bank_bytes_per_stride,
+)
 
 # flagship serving operating point (bench_decode.py's model dims; serving
 # runs far smaller batches than offline RL — lanes are REQUESTS here)
@@ -374,6 +388,101 @@ def main() -> None:
     bf16_tol = 0.05  # a few bf16 ulps relative to the encoder output scale
     bf16_within = bf16_drift <= bf16_tol * max(bf16_scale, 1e-9)
 
+    # ---- paged in-kernel attention rung -----------------------------------
+    # the same stride kernel, paged (in-kernel page-table DMA, no dense
+    # bank) vs its own dense-gather reference (paged=False), on both trace
+    # shapes. Off-TPU the kernel runs in interpret mode — far slower per
+    # stride than compiled Mosaic — so the rung shrinks its traces there;
+    # the paged-vs-gather comparison (same kernel math, same requests, one
+    # reading pages in-kernel, one through gather_bank) is exact everywhere.
+    m_pal = CaptionModel(dataclasses.replace(cfg, decode_impl="pallas"))
+    paged_n = n_req if backend == "tpu" else max(4, n_req // 6)
+    svc_paged = CaptionService(
+        m_pal, params, capacity=capacity, num_rollouts=K, max_len=max_len,
+        stride=stride,
+    )
+    svc_gather = CaptionService(
+        m_pal, params, capacity=capacity, num_rollouts=K, max_len=max_len,
+        stride=stride, paged=False,
+    )
+    print("bench_serving: warming paged_inkernel + dense_gather rungs",
+          file=sys.stderr)
+    svc_paged.serve(warm_reqs[:3])
+    svc_gather.serve(warm_reqs[:3])
+    paged_traces: dict[str, dict] = {}
+    paged_parity_ok = True
+    paged_checked = 0
+    for name, spec in specs.items():
+        pspec = dataclasses.replace(spec, num_requests=paged_n)
+        trace = make_trace(pspec)
+        rep_p = svc_paged.serve(requests_for(trace), realtime=True)
+        rep_g = svc_gather.serve(requests_for(trace), realtime=True)
+        ps = _policy_stats(rep_p, trace, slo_s)
+        gs = _policy_stats(rep_g, trace, slo_s)
+        # the in-run parity gate: identical math on identical bytes —
+        # token AND logprob bit-exact, per request, both traces
+        for rid in rep_p.results:
+            rp, rg = rep_p.results[rid], rep_g.results[rid]
+            paged_parity_ok = paged_parity_ok and bool(
+                np.array_equal(rp.tokens, rg.tokens)
+                and np.array_equal(rp.logprobs, rg.logprobs)
+            )
+            paged_checked += 1
+        paged_traces[name] = {
+            "num_requests": paged_n,
+            "paged_inkernel": ps,
+            "dense_gather": gs,
+            "goodput_ratio_paged_vs_gather": (
+                round(ps["goodput_rps"] / gs["goodput_rps"], 3)
+                if gs["goodput_rps"] else None
+            ),
+        }
+        print(f"bench_serving: {name} paged p50={ps['p50_s']}s "
+              f"goodput={ps['goodput_rps']}rps | gather p50={gs['p50_s']}s "
+              f"goodput={gs['goodput_rps']}rps", file=sys.stderr)
+    bank_itemsize = int(svc_paged.bank.mem.dtype.itemsize) \
+        if svc_paged.bank.mem is not None else 4
+    bank_paged = serving_bank_bytes_per_stride(
+        capacity, svc_paged.W, d_embed, d_att, bank_itemsize, paged=True
+    )
+    bank_dense = serving_bank_bytes_per_stride(
+        capacity, svc_paged.W, d_embed, d_att, bank_itemsize, paged=False
+    )
+
+    # stress: a pool TWICE one batch's dense-bank footprint. The gather
+    # path refuses it at construction (it re-materializes every lane's
+    # full window per stride); the paged engine admits it and the
+    # encode-ahead staging drives the page high-water mark past the
+    # footprint while every request still completes.
+    stress_cap, stress_page = 2, 2
+    stress_ppr = -(-len(modal) * frames // stress_page)
+    stress_pages = 2 * stress_cap * stress_ppr
+    svc_stress = CaptionService(
+        m_pal, params, capacity=stress_cap, num_rollouts=1,
+        max_len=max_len, stride=stride, frame_bucket=1,
+        page_size=stress_page, num_pages=stress_pages,
+    )
+    stress_reqs = requests_for(make_trace(TrafficSpec(
+        kind="poisson", rate_rps=1e9, num_requests=6, seed=31,
+        frame_choices=(frames,),
+    )))
+    stress_rep = svc_stress.serve(stress_reqs)
+    stress_footprint = stress_cap * svc_stress.table_width
+    hwm_exceeds = svc_stress.bank.pages_hwm > stress_footprint
+    try:
+        CaptionService(
+            model, params, capacity=stress_cap, num_rollouts=1,
+            max_len=max_len, stride=stride, frame_bucket=1,
+            page_size=stress_page, num_pages=stress_pages,
+        )
+        gather_refuses = False
+    except ValueError:
+        gather_refuses = True
+    print(f"bench_serving: stress pool={stress_pages} pages "
+          f"(dense footprint {stress_footprint}) hwm="
+          f"{svc_stress.bank.pages_hwm} gather_refuses={gather_refuses}",
+          file=sys.stderr)
+
     feat_dims = tuple(d for _, d in modal)
     _, per_tok = enc_and_per_tok_flops(
         frames, d_embed, d_hidden, d_att, vocab_n, feat_dims, 1
@@ -406,6 +515,21 @@ def main() -> None:
                 f"admit_group_f32={ag_f32_exact}, "
                 f"bf16_fallback={bf16_fell_back}, "
                 f"bf16_drift_within_tol={bf16_within}, traces={traces_out}"
+            )
+        # the paged gate is FATAL in-run: the in-kernel page reader must be
+        # bit-exact vs the dense-gather reference, and the oversized pool
+        # must genuinely fill past the dense footprint the gather refuses
+        if not (paged_parity_ok and hwm_exceeds and gather_refuses
+                and stress_rep.completed == len(stress_reqs)):
+            sys.exit(
+                "bench_serving: SMOKE FAILURE — paged in-kernel gate: "
+                f"paged_vs_gather_bit_exact={paged_parity_ok} "
+                f"(over {paged_checked} requests), "
+                f"hwm_exceeds_dense_footprint={hwm_exceeds} "
+                f"(hwm={svc_stress.bank.pages_hwm} vs {stress_footprint}), "
+                f"gather_refuses_pool={gather_refuses}, "
+                f"stress_completed={stress_rep.completed}/"
+                f"{len(stress_reqs)}"
             )
         # the SLO monitor must have judged the served traffic: target gauge
         # armed by set_slo() and per-window attainment/burn-rate populated
@@ -455,8 +579,31 @@ def main() -> None:
             "serving_decode_mfu_poisson": round(serving_mfu, 8),
             "assumed_peak_bf16_flops": peak,
         },
+        "paged": {
+            "requests_per_trace": paged_n,
+            "traces": paged_traces,
+            "per_stride_bank_bytes": {
+                "paged_inkernel": bank_paged,
+                "dense_gather": bank_dense,
+                "bytes_avoided_frac": round(1.0 - bank_paged / bank_dense, 4),
+            },
+            "parity": {
+                "paged_vs_gather_bit_exact": paged_parity_ok,
+                "checked_requests": paged_checked,
+            },
+            "stress": {
+                "pool_pages": stress_pages,
+                "dense_footprint_pages": stress_footprint,
+                "pages_hwm": int(svc_stress.bank.pages_hwm),
+                "completed": stress_rep.completed,
+                "requests": len(stress_reqs),
+            },
+        },
         "acceptance": {
             "continuous_beats_static_goodput": beats,
+            "paged_matches_dense_gather_bit_exact": bool(paged_parity_ok),
+            "paged_pool_exceeds_dense_footprint": bool(hwm_exceeds),
+            "gather_path_refuses_oversized_pool": bool(gather_refuses),
         },
         "note": (
             None if backend == "tpu" else

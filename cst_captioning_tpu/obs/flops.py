@@ -119,6 +119,27 @@ def decode_flops_per_clip(
     return float(enc_passes * enc + lanes * steps * per_tok * active_frac)
 
 
+def serving_bank_bytes_per_stride(
+    rows: int, width_slots: int, d_embed: int, d_att: int,
+    dtype_bytes: int = 4, paged: bool = False,
+) -> float:
+    """Encoder-bank HBM bytes one serving stride moves, per decode path.
+
+    The bank is ``rows`` lanes x ``width_slots`` memory slots of
+    ``(E mem + A proj + 1 mask)`` elements. The dense-gather path pays it
+    THREE times per stride: the gather reads the pool, writes the dense
+    [B, W, *] bank, and the stride kernel reads the bank back. The paged
+    in-kernel path DMAs each batch block's pages from the pool into VMEM
+    exactly once — one read, no dense bank — so its cost is the bank bytes
+    themselves. ``serving.gather_bytes_avoided`` counts the difference
+    (2x the bank) per paged stride dispatch. One ``dtype_bytes`` covers
+    all three pools (the mask pool is f32 even under a bf16 model — at
+    bf16 this overstates mask traffic by 2 of ~E+A+1 elements; the model
+    stays deliberately simple)."""
+    bank = float(rows) * width_slots * (d_embed + d_att + 1) * dtype_bytes
+    return bank if paged else 3.0 * bank
+
+
 def update_flops_per_clip(
     K: int, T: int, F: int, d_embed: int, d_hidden: int, d_att: int, V: int,
     feat_dims: tuple[int, ...], num_layers: int = 1,

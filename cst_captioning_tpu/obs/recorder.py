@@ -65,6 +65,8 @@ _PROBE_GAUGES = (
     "comm.bytes_on_wire", "comm.buckets", "health.peers_alive",
     "health.peer_age_max_s", "serving.slo.burn_rate.60s",
     "serving.param_version",
+    "serving.pages.in_use", "serving.pages.free",
+    "serving.pages.table_rows",
     "rl.actor.occupancy", "rl.learner.occupancy",
 )
 _PROBE_COUNTERS = (
@@ -75,6 +77,7 @@ _PROBE_COUNTERS = (
     "health.peer_lost",
     "resilience.regrow.attempts", "resilience.regrow.admitted",
     "resilience.regrow.refused",
+    "serving.gather_bytes_avoided",
 )
 
 _EVENTS_TAIL_LINES = 200

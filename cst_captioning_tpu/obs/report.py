@@ -280,6 +280,19 @@ def build_report(events: Iterable[dict]) -> dict[str, Any]:
             "pages_in_use": gauges.get("serving.pages_in_use"),
             "slots_in_use": gauges.get("serving.slots_in_use"),
             "queue_depth": gauges.get("serving.queue_depth"),
+            # paged in-kernel attention (ops/decode_pallas
+            # .fused_decode_stride_paged): device-resident page-table
+            # occupancy + encode-ahead staging depth + the HBM bytes the
+            # killed dense-bank gather would have moved
+            "pages": {
+                "in_use": gauges.get("serving.pages.in_use"),
+                "free": gauges.get("serving.pages.free"),
+                "table_rows": gauges.get("serving.pages.table_rows"),
+            },
+            "staged": counters.get("serving.requests_staged", 0),
+            "gather_bytes_avoided": counters.get(
+                "serving.gather_bytes_avoided", 0
+            ),
             # drain-free hot param swap (serving/engine.publish_params):
             # the active learner-param version plus applied/refused swaps
             "param_version": gauges.get("serving.param_version"),
@@ -562,6 +575,20 @@ def render_report(report: dict[str, Any]) -> str:
             )
         if sv.get("pages_in_use") is not None:
             bits.append(f"pages in use: {int(sv['pages_in_use'])}")
+        pg = sv.get("pages") or {}
+        if pg.get("in_use") is not None or pg.get("free") is not None:
+            bits.append(
+                f"page table: {int(pg.get('in_use') or 0)} in use / "
+                f"{int(pg.get('free') or 0)} free over "
+                f"{int(pg.get('table_rows') or 0)} row(s)"
+            )
+        if sv.get("staged"):
+            bits.append(f"staged admissions: {int(sv['staged'])}")
+        if sv.get("gather_bytes_avoided"):
+            bits.append(
+                "gather bytes avoided: "
+                f"{sv['gather_bytes_avoided'] / 2**20:.1f} MiB"
+            )
         if sv.get("param_swaps") or sv.get("param_swaps_refused"):
             bits.append(
                 f"param swaps: {int(sv['param_swaps'])} applied"

@@ -886,6 +886,394 @@ def fused_decode_stride(cell_params, carry, token, finished, memory,
     )
 
 
+# ---- paged stride kernel: page-table reads move INSIDE -----------------------
+#
+# The serving engine keeps each request's encoder memory in fixed-size HBM
+# pages (serving/pages.py, the Ragged Paged Attention layout of arXiv
+# 2604.15464) — but until now every stride first GATHERED the active lanes'
+# pages into the dense [B, W, E] bank the stride kernel consumes: a full
+# copy of all live memory per stride (read pool + write bank + kernel
+# re-reads bank = 3x the bank bytes), and a hard cap of one batch's dense
+# footprint on how large the pool can usefully grow. The paged variant
+# moves the page-table reads INSIDE the kernel:
+#
+#   the [B, max_pages] int32 page table rides as a SCALAR-PREFETCH operand
+#   (pltpu.PrefetchScalarGridSpec) so its entries are available to the
+#   kernel before the grid body runs; the three pools stay in HBM as
+#   unblocked ANY-space refs; and at each batch block's FIRST grid visit
+#   (g == 0, s == 0, vb == 0) the kernel DMAs each row's pages
+#   ``pool.at[table[row, p]]`` into a per-block VMEM slab scratch
+#   [block_b, W, *] (start-all-then-wait-all async copies). Scratch
+#   persists across the (g, s, vb) inner axes, so the slab is fetched
+#   ONCE per stride per batch block — exactly the residency the dense
+#   path's memory BlockSpec gave — and every later grid step runs the
+#   UNCHANGED dense stride kernel math against the slab refs.
+#
+# Bit-exactness vs the dense-gather path is by construction: the gather
+# (`jnp.take` per pool) and the DMA fill produce the same bytes in the
+# same [row, slot] layout (page 0 is the shared zero page either way), and
+# `_stride_kernel` then executes the identical program on them. Per-row
+# `mem_lens` raggedness composes unchanged: columns past a row's length
+# leave the softmax via the same -inf masking, so a row holding fewer
+# pages attends over exactly its own slots and the zero-page tail is
+# mathematically (not just numerically) excluded. Finished-block skipping
+# also composes: a compacted-away block (i past the n_active prefix)
+# skips the DMA fill along with all other work.
+
+def _paged_stride_kernel(*refs, num_layers: int, page_size: int,
+                         table_width: int, pad_m: int, V: int, S: int,
+                         temperature: float, min_len: int, block_v: int):
+    L = num_layers
+    # the dense kernel's operand counts: 5 leading + 2L carry + 3 bank +
+    # 3 attention + 3L lstm + 4 trailing inputs; 2 + 2L outputs
+    n_in = 15 + 5 * L
+    n_out = 2 + 2 * L
+    tbl_ref = refs[0]                       # scalar prefetch: [Bp, width]
+    ins = refs[1:1 + n_in]
+    outs = refs[1 + n_in:1 + n_in + n_out]
+    slab_mem, slab_proj, slab_mask, dma_sem = refs[
+        1 + n_in + n_out:5 + n_in + n_out]
+    inner_scratch = refs[5 + n_in + n_out:]
+
+    nact_ref = ins[1]
+    # the pools sit at the dense kernel's mem/proj/mask positions, but as
+    # unblocked HBM refs ([N+1, P, E] / [N+1, P, A] / [N+1, P])
+    mem_hbm, proj_hbm, mask_hbm = ins[5 + 2 * L:8 + 2 * L]
+
+    i = pl.program_id(0)
+    first = (
+        (pl.program_id(1) == 0) & (pl.program_id(2) == 0)
+        & (pl.program_id(3) == 0)
+    )
+    bb = slab_mem.shape[0]
+    active = i * bb < nact_ref[0]
+    W = table_width * page_size
+
+    @pl.when(active & first)
+    def _():
+        if pad_m:
+            # TPU lane-alignment tail past the true W slots: zero it so the
+            # (exactly-zero-weighted) context sum never reads uninitialized
+            # VMEM — 0 * garbage is only 0 when the garbage is finite
+            tail = pl.ds(W, pad_m)
+            slab_mem[:, tail, :] = jnp.zeros(
+                (bb, pad_m, slab_mem.shape[2]), slab_mem.dtype
+            )
+            slab_proj[:, tail, :] = jnp.zeros(
+                (bb, pad_m, slab_proj.shape[2]), slab_proj.dtype
+            )
+            slab_mask[:, tail] = jnp.zeros((bb, pad_m), slab_mask.dtype)
+        copies = []
+        for r in range(bb):
+            for p in range(table_width):
+                pg = tbl_ref[i * bb + r, p]
+                dst = pl.ds(p * page_size, page_size)
+                copies.append(pltpu.make_async_copy(
+                    mem_hbm.at[pg], slab_mem.at[r, dst, :], dma_sem
+                ))
+                copies.append(pltpu.make_async_copy(
+                    proj_hbm.at[pg], slab_proj.at[r, dst, :], dma_sem
+                ))
+                copies.append(pltpu.make_async_copy(
+                    mask_hbm.at[pg], slab_mask.at[r, dst], dma_sem
+                ))
+        # start ALL page fetches before waiting on any: the DMA engine
+        # overlaps them; program order only pins issue order
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+
+    # the unchanged dense stride program, with the slab scratches standing
+    # in for the dense bank's blocked refs — identical math on identical
+    # bytes is the whole bit-exactness argument
+    inner = (
+        ins[:5 + 2 * L] + (slab_mem, slab_proj, slab_mask)
+        + ins[8 + 2 * L:] + outs + inner_scratch
+    )
+    _stride_kernel(
+        *inner, num_layers=L, m_true=W, V=V, S=S, temperature=temperature,
+        min_len=min_len, block_v=block_v,
+    )
+
+
+def _gather_pages(mem_pool, proj_pool, mask_pool, table):
+    """Dense [B, W, *] bank from pools + table — the XLA fallback and the
+    parity oracle the paged kernel is pinned bit-exact against (page 0 is
+    the shared zero page, so table padding gathers excluded slots)."""
+    B, width = table.shape
+    P = mem_pool.shape[1]
+    flat = table.reshape(-1)
+    mem = jnp.take(mem_pool, flat, axis=0).reshape(B, width * P, -1)
+    proj = jnp.take(proj_pool, flat, axis=0).reshape(B, width * P, -1)
+    mask = jnp.take(mask_pool, flat, axis=0).reshape(B, width * P)
+    return mem, proj, mask
+
+
+def _paged_stride_call(cell_params, carry, emb0, finished, mem_pool,
+                       proj_pool, mask_pool, table, noise, t0, n_active,
+                       mem_lens, *, S: int, temperature: float,
+                       min_len: int, block_b: int, block_v: int,
+                       interpret: bool):
+    L = _num_layers(cell_params)
+    G, B, E = emb0.shape
+    P = mem_pool.shape[1]
+    Em = mem_pool.shape[2]
+    A = proj_pool.shape[2]
+    width = table.shape[1]
+    W = width * P
+    H = carry[0][0].shape[-1]
+    wo = cell_params["out_proj"]["kernel"]
+    bo = cell_params["out_proj"]["bias"][None, :]
+    embt = jnp.asarray(cell_params["word_embed"]["embedding"])
+    V = wo.shape[-1]
+
+    block_b = min(block_b, B) if B else block_b
+    Bp = -(-B // block_b) * block_b
+    block_v = min(block_v, -(-V // 128) * 128 if V > 128 else V)
+    Vp = -(-V // block_v) * block_v
+    Wp = -(-W // 128) * 128 if not interpret else W
+
+    emb0p = _pad_to(emb0, 1, block_b)
+    fin0p = _pad_to(finished.astype(jnp.int32), 1, block_b, value=1)
+    if mem_lens is None:
+        mem_lens = jnp.full((B,), W, jnp.int32)
+    lensp = _pad_to(
+        jnp.clip(mem_lens.astype(jnp.int32), 1, W)[:, None], 0, block_b,
+        value=1,
+    )
+    carryp = [
+        (_pad_to(c, 1, block_b), _pad_to(h, 1, block_b)) for c, h in carry
+    ]
+    # padded table rows point every slot at the shared zero page
+    tablep = _pad_to(table.astype(jnp.int32), 0, block_b)
+    wop = _pad_to(wo, 1, block_v)
+    bop = _pad_to(bo, 1, block_v)
+    embtp = _pad_to(embt, 0, block_v)
+    noisep = _pad_to(_pad_to(noise, 2, block_b), 3, block_v)
+
+    att = cell_params["attention"]
+    wq = att["query_proj"]["kernel"]
+    bq = att["query_proj"]["bias"][None, :]
+    vs = att["score"]["kernel"][:, 0][None, :]
+
+    # index maps gain the trailing scalar-prefetch ref (PrefetchScalarGridSpec
+    # passes it after the grid indices); none of them consults it — the
+    # table is read in-kernel, not at block-selection time
+    smem = pl.BlockSpec((1,), lambda i, g, s, vb, tbl: (0,),
+                        memory_space=pltpu.SMEM)
+    const = lambda i, g, s, vb, tbl: (0, 0)   # noqa: E731 — grid-invariant
+    in_specs = [smem, smem]
+    args = [
+        jnp.asarray(t0, jnp.int32).reshape(1),
+        jnp.asarray(n_active, jnp.int32).reshape(1),
+    ]
+    in_specs += [
+        pl.BlockSpec((1, block_b, E), lambda i, g, s, vb, tbl: (g, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_b), lambda i, g, s, vb, tbl: (g, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, 1), lambda i, g, s, vb, tbl: (i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [emb0p, fin0p, lensp]
+    for c, h in carryp:
+        for arr in (c, h):
+            in_specs.append(
+                pl.BlockSpec((1, block_b, H),
+                             lambda i, g, s, vb, tbl: (g, i, 0),
+                             memory_space=pltpu.VMEM)
+            )
+            args.append(arr)
+    in_specs += [
+        # the pools stay whole in HBM; the kernel DMAs pages out by table id
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec((H, A), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, A), const, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, A), const, memory_space=pltpu.VMEM),
+    ]
+    args += [mem_pool, proj_pool, mask_pool, wq, bq, vs]
+    for layer in range(L):
+        wi, wh, b = _gate_weights(cell_params[f"lstm{layer}"])
+        in_specs += [
+            pl.BlockSpec(wi.shape, const, memory_space=pltpu.VMEM),
+            pl.BlockSpec(wh.shape, const, memory_space=pltpu.VMEM),
+            pl.BlockSpec(b.shape, const, memory_space=pltpu.VMEM),
+        ]
+        args += [wi, wh, b]
+    in_specs += [
+        pl.BlockSpec((H, block_v), lambda i, g, s, vb, tbl: (0, vb),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_v), lambda i, g, s, vb, tbl: (0, vb),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_v, E), lambda i, g, s, vb, tbl: (vb, 0),
+                     memory_space=pltpu.VMEM),
+        # lane 0 draws no noise; its (unused) block aliases lane 1's so the
+        # fetch is a repeat, not extra traffic
+        pl.BlockSpec((1, 1, block_b, block_v),
+                     lambda i, g, s, vb, tbl:
+                     (s, jnp.maximum(g - 1, 0), i, vb),
+                     memory_space=pltpu.VMEM),
+    ]
+    args += [wop, bop, embtp, noisep]
+
+    vma = frozenset()
+    for x in (emb0, mem_pool, proj_pool, mask_pool, table, finished, noise,
+              *jax.tree.leaves(carry)):
+        vma = vma | vma_of(x)
+    sds = (
+        (lambda sh, d: jax.ShapeDtypeStruct(sh, d, vma=vma)) if vma
+        else jax.ShapeDtypeStruct
+    )
+    out_shape = [sds((S, G, Bp), jnp.int32), sds((S, G, Bp), jnp.float32)]
+    out_specs = [
+        pl.BlockSpec((1, 1, block_b), lambda i, g, s, vb, tbl: (s, g, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, block_b), lambda i, g, s, vb, tbl: (s, g, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    for c, h in carry:
+        for arr in (c, h):
+            out_shape.append(sds((G, Bp, H), arr.dtype))
+            out_specs.append(
+                pl.BlockSpec((1, block_b, H),
+                             lambda i, g, s, vb, tbl: (g, i, 0),
+                             memory_space=pltpu.VMEM)
+            )
+
+    scratch = [
+        # per-block page slabs, in the pools' OWN dtypes (the dense path
+        # gathers without a cast, so the slab must hold the same bytes)
+        pltpu.VMEM((block_b, Wp, Em), mem_pool.dtype),
+        pltpu.VMEM((block_b, Wp, A), proj_pool.dtype),
+        pltpu.VMEM((block_b, Wp), mask_pool.dtype),
+        pltpu.SemaphoreType.DMA,
+        # the dense kernel's own scratch, unchanged
+        pltpu.VMEM((block_b, H), jnp.float32),    # x_stash
+        pltpu.VMEM((block_b, E), jnp.float32),    # current-step embedding
+        pltpu.VMEM((block_b, E), jnp.float32),    # candidate embedding
+        pltpu.VMEM((1, E), jnp.float32),          # PAD embedding
+        pltpu.VMEM((block_b, 1), jnp.float32),    # running best sel value
+        pltpu.VMEM((block_b, 1), jnp.int32),      # running best token
+        pltpu.VMEM((block_b, 1), jnp.float32),    # its untempered logit
+        pltpu.VMEM((block_b, 1), jnp.float32),    # online lse max
+        pltpu.VMEM((block_b, 1), jnp.float32),    # online lse sumexp
+        pltpu.VMEM((block_b, 1), jnp.int32),      # finished
+    ]
+    for _ in range(L):
+        scratch += [
+            pltpu.VMEM((block_b, H), jnp.float32),
+            pltpu.VMEM((block_b, H), jnp.float32),
+        ]
+
+    grid = (Bp // block_b, G, S, Vp // block_v)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    outs = pl.pallas_call(
+        functools.partial(
+            _paged_stride_kernel, num_layers=L, page_size=P,
+            table_width=width, pad_m=Wp - W, V=V, S=S,
+            temperature=temperature, min_len=min_len, block_v=block_v,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(tablep, *args)
+    tokens = outs[0][:, :, :B]
+    lps = outs[1][:, :, :B]
+    flat = outs[2:]
+    new_carry = tuple(
+        (flat[2 * layer][:, :B], flat[2 * layer + 1][:, :B])
+        for layer in range(L)
+    )
+    return new_carry, tokens, lps
+
+
+def fused_decode_stride_paged(cell_params, carry, token, finished,
+                              mem_pool, proj_pool, mask_pool, page_table,
+                              noise, t0, n_active=None, *, steps: int,
+                              temperature: float = 1.0, min_len: int = 0,
+                              num_layers: int | None = None,
+                              block_b: int = 32, block_v: int = 1024,
+                              mem_lens=None):
+    """:func:`fused_decode_stride` reading paged memory in-kernel.
+
+    Same contract and returns, but the dense ``memory`` / ``memory_proj``
+    / ``memory_mask`` bank is replaced by the page pools
+    (``mem_pool [N+1, P, E]``, ``proj_pool [N+1, P, A]``,
+    ``mask_pool [N+1, P]`` — row 0 is the shared zero page) plus a
+    ``page_table [B, max_pages]`` int32 mapping each batch row to its pool
+    rows (zero-page-padded past the row's own pages). The table rides as a
+    scalar-prefetch operand and the kernel DMAs each batch block's pages
+    from HBM into a VMEM slab once per stride — no dense [B, W, E] bank is
+    ever materialized, so the pool may exceed one batch's dense footprint.
+    Token- and logprob-bit-exact vs running :func:`fused_decode_stride`
+    on the :func:`serving.pages.gather_bank` dense gather of the same
+    pools (pinned in tests/test_ops_decode_pallas.py). ``mem_lens`` defaults to
+    every row's full ``max_pages * P`` window; serving passes each row's
+    true length. Inference-only, like the dense stride.
+    """
+    if num_layers is not None and num_layers != _num_layers(cell_params):
+        raise ValueError(
+            f"num_layers {num_layers} does not match the "
+            f"{_num_layers(cell_params)} lstm layers in cell_params"
+        )
+    G, B = token.shape
+    if G < 2:
+        raise ValueError(
+            "fused_decode_stride_paged needs the (1+K)-lane layout with "
+            f"K >= 1 sampled lanes; got G={G}"
+        )
+    if noise.shape[:3] != (steps, G - 1, B):
+        raise ValueError(
+            f"noise shape {noise.shape} does not match "
+            f"[steps={steps}, K={G - 1}, B={B}, V]"
+        )
+    if page_table.ndim != 2 or page_table.shape[0] != B:
+        raise ValueError(
+            f"page_table shape {page_table.shape} does not match "
+            f"[B={B}, max_pages]"
+        )
+    if mem_pool.ndim != 3 or proj_pool.ndim != 3 or mask_pool.ndim != 2:
+        raise ValueError(
+            "pools must be [N+1, P, E] / [N+1, P, A] / [N+1, P]; got "
+            f"{mem_pool.shape} / {proj_pool.shape} / {mask_pool.shape}"
+        )
+    if n_active is None:
+        n_active = B
+    interpret = jax.default_backend() != "tpu"
+    if interpret and any(
+        vma_of(x)
+        for x in (mem_pool, proj_pool, mask_pool, page_table, finished,
+                  noise, *jax.tree.leaves(carry))
+    ):
+        # Pallas interpret mode can't run under a varying-axis-checked
+        # shard_map — gather the dense bank and run the composite (CPU
+        # tests only; compiled Mosaic on TPU runs the kernel everywhere)
+        memory, memory_proj, memory_mask = _gather_pages(
+            mem_pool, proj_pool, mask_pool, page_table
+        )
+        return _reference_stride(
+            cell_params, carry, token, finished, memory, memory_proj,
+            memory_mask, noise, t0, steps=steps, temperature=temperature,
+            min_len=min_len, mem_lens=mem_lens,
+        )
+    emb0 = jnp.asarray(cell_params["word_embed"]["embedding"])[token]
+    return _paged_stride_call(
+        cell_params, carry, emb0, finished, mem_pool, proj_pool, mask_pool,
+        page_table, noise, t0, n_active, mem_lens, S=steps,
+        temperature=temperature, min_len=min_len, block_b=block_b,
+        block_v=block_v, interpret=interpret,
+    )
+
+
 # ---- beam step kernel: per-step top-k moves INSIDE ---------------------------
 #
 # The lane-batched beam search (decoding/beam.py, beam_impl="lanes") maps

@@ -70,10 +70,17 @@ from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
 from cst_captioning_tpu import obs
 from cst_captioning_tpu.obs import anomaly as obs_anomaly
 from cst_captioning_tpu.obs import recorder as obs_recorder
-from cst_captioning_tpu.obs.flops import enc_and_per_tok_flops
+from cst_captioning_tpu.obs.flops import (
+    enc_and_per_tok_flops,
+    serving_bank_bytes_per_stride,
+)
 from cst_captioning_tpu.resilience import chaos
 from cst_captioning_tpu.resilience.preempt import PreemptionHandler
-from cst_captioning_tpu.serving.pages import OutOfPages, PageBank
+from cst_captioning_tpu.serving.pages import (
+    OutOfPages,
+    PageBank,
+    gather_bank,
+)
 
 
 @dataclass(frozen=True)
@@ -131,8 +138,12 @@ class _Ticket:
     # the param version active at admission: every stride of this request
     # decodes under THIS version's params even after a hot swap (per-lane
     # version pinning — the request is bit-identical to its offline decode
-    # under the admission version)
+    # under the admission version). Staged (encoded, lane-less) requests
+    # pin at ENCODE time: the encoder already ran under that version.
     param_version: int = 0
+    # encode-ahead staging: the encoder carry parked on device until a
+    # lane frees (tiny: L x 2 x [1, H] leaves); dropped at lane bind
+    enc_carry: object = None
 
 
 class SloMonitor:
@@ -271,6 +282,7 @@ class CaptionService:
         frame_bucket: int | None = None,
         kernel_block_b: int = 1,
         admit_group: int = 1,
+        paged: bool | None = None,
         clock: Callable[[], float] = time.monotonic,
         slo_target_s: float = 0.0,
         slo_objective: float = 0.99,
@@ -321,9 +333,34 @@ class CaptionService:
             # default pool: every lane can hold a max-length clip (the
             # padded-slab equivalent); size it DOWN to see backpressure
             num_pages = self.B * pages_per_row
+        # paged in-kernel attention (default wherever the stride kernel
+        # runs): the stride reads pages straight from the pool by table
+        # lookup — no dense [B, W, E] bank per stride, and the pool may
+        # exceed one batch's dense footprint (encode-ahead staging below
+        # fills the surplus). paged=False forces the dense-gather path
+        # (the XLA decode always gathers).
+        self.paged = self.use_kernel if paged is None else bool(paged)
+        if self.paged and not self.use_kernel:
+            raise ValueError(
+                "paged=True needs decode_impl='pallas' — the XLA decode "
+                "path has no in-kernel page reader (it runs the "
+                "gather_bank fallback); leave paged unset or False"
+            )
+        if not self.paged and int(num_pages) > self.B * pages_per_row:
+            raise ValueError(
+                f"num_pages {num_pages} exceeds one batch's dense-bank "
+                f"footprint ({self.B} lanes x {pages_per_row} pages) — "
+                "the dense-gather path re-materializes every lane's full "
+                "window per stride, so surplus pages can never be "
+                "admitted; use decode_impl='pallas' with paged=True "
+                "(the in-kernel page reader) to grow the pool past it"
+            )
         self.bank = PageBank(num_pages, page)
         self.table_width = pages_per_row
         self.W = pages_per_row * page     # gathered memory width per row
+        # device-resident per-lane page table: bound/cleared at admission
+        # and completion, consumed directly by every stride dispatch
+        self.bank.init_rows(self.B, self.table_width)
 
         # admission-group encode width. 1 (default) = one encoder pass per
         # request, which is what makes a served request bit-identical to
@@ -358,6 +395,13 @@ class CaptionService:
         self._queue: deque[ClipRequest] = deque()
         self._tickets: dict[str, _Ticket] = {}
         self._inflight: dict[int, _Ticket] = {}   # slot -> ticket
+        # encode-ahead staging (paged only): requests encoded and paged in
+        # while every lane is busy — they bind a lane with NO encoder pass
+        # the moment one frees. This is what makes a pool larger than one
+        # batch's dense footprint USEFUL: staged pages are bounded by the
+        # pool, not by lane count. FIFO order: staged requests came off
+        # the queue front, and bind before any new admission.
+        self._staged: deque[str] = deque()
         self._free_slots: deque[int] = deque(range(self.B))
         self._state = None                        # lazy device lane state
         self._drain = threading.Event()
@@ -465,6 +509,7 @@ class CaptionService:
         self.B = new_b
         self._free_slots.extend(range(old_b, new_b))
         self.bank.grow(self.bank.num_pages + grown * self.table_width)
+        self.bank.grow_rows(new_b)
         self._stride_fn = self._build_stride_fn()
         if self._state is not None:
             carry, token, finished, t_local, keys = self._state
@@ -565,8 +610,9 @@ class CaptionService:
             return False
         self._pending_publish = None
         prev = self.param_version
-        if self._inflight:
-            # in-flight lanes pin the outgoing version until they complete
+        if self._inflight or self._staged:
+            # in-flight lanes AND staged (encoded, lane-less) requests pin
+            # the outgoing version until they complete
             self._old_params[prev] = self.params
         self.params = params
         self.param_version = version
@@ -597,6 +643,10 @@ class CaptionService:
         if not self._old_params:
             return
         live = {t.param_version for t in self._inflight.values()}
+        live |= {
+            self._tickets[r].param_version
+            for r in self._staged if r in self._tickets
+        }
         for v in [v for v in self._old_params if v not in live]:
             del self._old_params[v]
             obs.counter("serving.param_versions_retired").inc()
@@ -679,6 +729,10 @@ class CaptionService:
                     self.bank.free(ticket.req.req_id)
                     self._free_slots.append(slot)
                     self._tickets.pop(ticket.req.req_id, None)
+                for rid in self._staged:
+                    self.bank.free(rid)
+                    self._tickets.pop(rid, None)
+                self._staged.clear()
                 for req in self._queue:
                     self._tickets.pop(req.req_id, None)
                 self._queue.clear()
@@ -757,14 +811,23 @@ class CaptionService:
         return compiled_cost(
             self._stride_fn, self.params,
             (self.bank.mem, self.bank.proj, self.bank.mask),
-            np.zeros((B, self.table_width), np.int32),
-            np.zeros((B,), np.int32), perm, perm, np.int32(B), self._state,
+            self.bank.row_table, self.bank.row_lens,
+            perm, perm, np.int32(B), self._state,
             np.ones((B,), bool),
         )
 
     # ---- admission ----------------------------------------------------------
 
     def _admit_arrived(self, now, realtime: bool) -> None:
+        # staged requests bind freed lanes FIRST (they left the queue front
+        # earlier, so FIFO holds) — binding is encode-free: the pages and
+        # the parked encoder carry already exist
+        while self._staged and self._free_slots:
+            rid = self._staged.popleft()
+            ticket = self._tickets[rid]
+            with obs.span("serving.bind_staged", req=rid):
+                self._bind_lane(ticket)
+            obs.counter("serving.staged_bound").inc()
         # collect every currently-admissible request (a free lane AND
         # enough free pages), grouped by frame bucket — each group encodes
         # as ONE batched pass. Per-row encoder math is batch-composition
@@ -793,14 +856,42 @@ class CaptionService:
                 chunk = reqs[i:i + self.admit_group]
                 with obs.span("serving.admit", requests=len(chunk)):
                     self._admit_group(F, chunk, now)
-        if groups or self._queue:
+        # encode-ahead staging (paged path only): every lane is busy but
+        # pages are free — encode queue-front requests NOW and park their
+        # pages, so (a) a freed lane binds with zero encode on its critical
+        # path and (b) the pool's surplus past one batch's dense footprint
+        # actually fills. The dense-gather path cannot do this: its pool is
+        # constructor-capped at the dense footprint.
+        sgroups: dict[int, list[ClipRequest]] = {}
+        if self.paged and not self._free_slots:
+            reserved = 0
+            while self._queue:
+                req = self._queue[0]
+                if realtime and req.arrival_s > now():
+                    break
+                n_pages = self.bank.pages_for(
+                    self.n_mod * self._padded_frames(req)
+                )
+                if self.bank.free_pages - reserved < n_pages:
+                    break
+                self._queue.popleft()
+                sgroups.setdefault(self._padded_frames(req), []).append(req)
+                reserved += n_pages
+            for F, reqs in sgroups.items():
+                for i in range(0, len(reqs), self.admit_group):
+                    chunk = reqs[i:i + self.admit_group]
+                    with obs.span("serving.stage", requests=len(chunk)):
+                        self._admit_group(F, chunk, now, stage=True)
+            obs.gauge("serving.staged").set(len(self._staged))
+        if groups or sgroups or self._queue:
             obs.gauge("serving.queue_depth").set(len(self._queue))
 
     def _padded_frames(self, req: ClipRequest) -> int:
         b = self.frame_bucket
         return min(-(-req.num_frames // b) * b, self.model.cfg.max_frames)
 
-    def _admit_group(self, F: int, reqs: list[ClipRequest], now) -> None:
+    def _admit_group(self, F: int, reqs: list[ClipRequest], now,
+                     stage: bool = False) -> None:
         t_admit = now()
         t_enc0 = time.perf_counter()
         with obs.span("serving.encode", requests=len(reqs)):
@@ -818,17 +909,13 @@ class CaptionService:
                 pages, enc_i.memory, enc_i.memory_proj, enc_i.memory_mask
             )
             ticket.t_encoded = now()
-            slot = self._free_slots.popleft()
-            ticket.slot = slot
-            ticket.tok = np.full((self.G, self.T), PAD_ID, np.int32)
-            ticket.lp = np.zeros((self.G, self.T), np.float32)
-            self._inflight[slot] = ticket
-            self._ensure_state(enc_i)
-            key_raw = self._key_fn(jax.device_put(np.int32(req.seed)))
-            self._state = self._admit_fn(
-                self._state, jax.device_put(np.int32(slot)), enc_i.carry,
-                key_raw,
-            )
+            ticket.enc_carry = enc_i.carry
+            self._ensure_state(enc_i.carry)
+            if stage:
+                self._staged.append(req.req_id)
+                obs.counter("serving.requests_staged").inc()
+            else:
+                self._bind_lane(ticket)
             obs.counter("serving.requests_admitted").inc()
             obs.counter("flops.serving.encode").inc(self._enc_flops)
             obs.histogram("serving.queue_wait_seconds").observe(
@@ -837,6 +924,24 @@ class CaptionService:
             obs.histogram("serving.encode_seconds").observe(enc_s)
         obs.gauge("serving.slots_in_use").set(len(self._inflight))
         obs.gauge("serving.pages_in_use").set(self.bank.pages_in_use)
+
+    def _bind_lane(self, ticket: _Ticket) -> None:
+        """Bind an encoded request (fresh or staged) to a free lane: set
+        the device page-table row, seed the lane state from the parked
+        encoder carry, arm the request's own RNG stream. No encoder work —
+        the encode happened at admission/staging time."""
+        slot = self._free_slots.popleft()
+        ticket.slot = slot
+        ticket.tok = np.full((self.G, self.T), PAD_ID, np.int32)
+        ticket.lp = np.zeros((self.G, self.T), np.float32)
+        self._inflight[slot] = ticket
+        self.bank.bind_row(slot, ticket.req.req_id)
+        key_raw = self._key_fn(jax.device_put(np.int32(ticket.req.seed)))
+        self._state = self._admit_fn(
+            self._state, jax.device_put(np.int32(slot)), ticket.enc_carry,
+            key_raw,
+        )
+        ticket.enc_carry = None  # the lane state owns the carry now
 
     def _encode_batch(self, reqs: list[ClipRequest], F: int) -> EncoderOutput:
         """One batched encoder pass for an admission group. The batch dim
@@ -873,7 +978,7 @@ class CaptionService:
 
     # ---- device lane state --------------------------------------------------
 
-    def _ensure_state(self, enc: EncoderOutput) -> None:
+    def _ensure_state(self, enc_carry) -> None:
         if self._state is not None:
             return
         G, B = self.G, self.B
@@ -882,7 +987,7 @@ class CaptionService:
                 jnp.zeros((G, B) + c.shape[1:], c.dtype),
                 jnp.zeros((G, B) + h.shape[1:], h.dtype),
             )
-            for c, h in enc.carry
+            for c, h in enc_carry
         )
         # key-data layout probed abstractly (eval_shape: no device values,
         # no transfers — the impl-dependent raw width is all we need)
@@ -896,8 +1001,6 @@ class CaptionService:
             jnp.zeros((B,), jnp.int32),
             jnp.zeros((B,) + key_aval.shape, key_aval.dtype),
         )
-        L = len(enc.carry)
-
         def admit(state, col, enc_carry, key_raw):
             carry, token, finished, t_local, keys = state
             new_carry = tuple(
@@ -919,6 +1022,7 @@ class CaptionService:
                 keys.at[col].set(key_raw),
             )
 
+        L = len(enc_carry)
         assert L == len(carry)
         self._admit_fn = jax.jit(admit, donate_argnums=(0,))
 
@@ -930,6 +1034,7 @@ class CaptionService:
         V = model.cfg.vocab_size
         temp, min_len = self.temperature, self.min_len
         use_kernel = self.use_kernel
+        paged = self.paged
         num_layers = model.cfg.num_layers
         kernel_block_b = self.kernel_block_b
 
@@ -973,17 +1078,10 @@ class CaptionService:
             fin_c = fin_c | ~mask_c[None, :]
             t_c = jnp.take(t_local, perm)
             keys_c = jnp.take(keys, perm, axis=0)
-            mem_pool, proj_pool, mask_pool = pools
-            flat = jnp.take(table, perm, axis=0).reshape(-1)
-            mem = jnp.take(mem_pool, flat, axis=0).reshape(
-                B, W, mem_pool.shape[-1]
-            )
-            proj = jnp.take(proj_pool, flat, axis=0).reshape(
-                B, W, proj_pool.shape[-1]
-            )
-            mask = jnp.take(mask_pool, flat, axis=0).reshape(B, W)
+            # compaction permutes TABLE ROWS, never pages: the permuted
+            # [B, width] table is all the decode needs on either path
+            table_c = jnp.take(table, perm, axis=0)
             lens_c = jnp.take(lens, perm)
-            enc_c = EncoderOutput(mem, proj, mask, ())
             if K:
                 noise = jnp.transpose(
                     jax.vmap(row_noise)(keys_c, t_c), (1, 2, 0, 3)
@@ -991,14 +1089,31 @@ class CaptionService:
             else:
                 noise = jnp.zeros((S, 0, B, V), jnp.float32)
 
-            if use_kernel:
+            if use_kernel and paged:
+                from cst_captioning_tpu.ops.decode_pallas import (
+                    fused_decode_stride_paged,
+                )
+
+                # pool + table pass straight through: the kernel resolves
+                # pages by table lookup — no dense bank this stride
+                carry_c, toks, lps = fused_decode_stride_paged(
+                    params["params"]["cell"], carry_c, token_c, fin_c,
+                    *pools, table_c, noise, jnp.int32(0), n_active,
+                    steps=S, temperature=temp, min_len=0,
+                    num_layers=num_layers, mem_lens=lens_c,
+                    block_b=kernel_block_b,
+                )
+                fin_c = fin_c | jnp.any(toks == EOS_ID, axis=0)
+                token_c = toks[-1]
+            elif use_kernel:
                 from cst_captioning_tpu.ops.decode_pallas import (
                     fused_decode_stride,
                 )
 
+                mem, proj, mask = gather_bank(pools, table_c)
                 carry_c, toks, lps = fused_decode_stride(
                     params["params"]["cell"], carry_c, token_c, fin_c,
-                    enc_c.memory, enc_c.memory_proj, enc_c.memory_mask,
+                    mem, proj, mask,
                     noise, jnp.int32(0), n_active, steps=S,
                     temperature=temp, min_len=0, num_layers=num_layers,
                     mem_lens=lens_c, block_b=kernel_block_b,
@@ -1006,6 +1121,8 @@ class CaptionService:
                 fin_c = fin_c | jnp.any(toks == EOS_ID, axis=0)
                 token_c = toks[-1]
             else:
+                mem, proj, mask = gather_bank(pools, table_c)
+                enc_c = EncoderOutput(mem, proj, mask, ())
                 def step(st, s):
                     carry_s, token_s, fin_s = st
                     carry_s, logits = lane_decode_step(
@@ -1069,12 +1186,9 @@ class CaptionService:
         )
         perm = np.concatenate([perm, rest])
         inv = np.argsort(perm, kind="stable").astype(np.int32)
-        owners = [None] * self.B
-        lens = np.zeros((self.B,), np.int32)
-        for slot, ticket in self._inflight.items():
-            owners[slot] = ticket.req.req_id
-            lens[slot] = self.bank.length(ticket.req.req_id)
-        table = self.bank.table(owners, self.table_width)
+        # the page table and per-lane lengths are DEVICE-resident (bound at
+        # lane bind, cleared at completion) — nothing per-stride to build
+        # or upload for them; only the permutation/masks cross per stride
         # group active lanes by admission-pinned param version: one stride
         # dispatch per LIVE version, each under that version's params with
         # the other versions' lanes frozen (step_mask). The common single-
@@ -1100,17 +1214,16 @@ class CaptionService:
             "serving.stride", active=len(active), versions=len(versions)
         ):
             dev = jax.device_put(
-                (table, lens, perm, inv, np.int32(len(active)),
-                 tuple(masks))
+                (perm, inv, np.int32(len(active)), tuple(masks))
             )
-            table_d, lens_d, perm_d, inv_d, n_d, masks_d = dev
+            perm_d, inv_d, n_d, masks_d = dev
             outs = []
             for v, mask_d in zip(versions, masks_d):
                 self._state, toks, lps = self._stride_fn(
                     self._params_for(v),
                     (self.bank.mem, self.bank.proj, self.bank.mask),
-                    table_d, lens_d, perm_d, inv_d, n_d, self._state,
-                    mask_d,
+                    self.bank.row_table, self.bank.row_lens,
+                    perm_d, inv_d, n_d, self._state, mask_d,
                 )
                 outs.append((toks, lps))
             # the per-stride sync point: ONE explicit readback of the small
@@ -1123,6 +1236,25 @@ class CaptionService:
         obs.counter("flops.serving.stride").inc(
             len(active) * self.G * self.S * self._tok_flops
         )
+        obs.gauge("serving.pages.in_use").set(self.bank.pages_in_use)
+        obs.gauge("serving.pages.free").set(self.bank.free_pages)
+        obs.gauge("serving.pages.table_rows").set(self.B)
+        if self.paged and self.bank.mem is not None:
+            # the dense-gather path would have paid 3x the bank bytes per
+            # dispatch (pool read + bank write + kernel read); the paged
+            # kernel pays 1x — count the 2x saved, per version dispatch
+            E = int(self.bank.mem.shape[-1])
+            A = int(self.bank.proj.shape[-1])
+            nbytes = int(self.bank.mem.dtype.itemsize)
+            dense = serving_bank_bytes_per_stride(
+                self.B, self.W, E, A, nbytes, paged=False
+            )
+            paged = serving_bank_bytes_per_stride(
+                self.B, self.W, E, A, nbytes, paged=True
+            )
+            obs.counter("serving.gather_bytes_avoided").inc(
+                len(versions) * (dense - paged)
+            )
         for v, (toks_np, lps_np) in zip(versions, outs_np):
             for slot in by_ver[v]:
                 ticket = self._inflight[slot]
@@ -1150,6 +1282,7 @@ class CaptionService:
         t_done = now()
         self._inflight.pop(ticket.slot)
         self._free_slots.append(ticket.slot)
+        self.bank.clear_row(ticket.slot)
         self.bank.free(ticket.req.req_id)
         # evict the ticket: an always-on service must not grow state per
         # served request (and a later request may legitimately reuse an id)
@@ -1205,12 +1338,15 @@ class CaptionService:
         if snapshot_dir is None:
             return None
         os.makedirs(snapshot_dir, exist_ok=True)
-        # in-flight first (they were admitted earlier), then queue order —
-        # replay preserves the service order
+        # in-flight first (they were admitted earlier), then staged (encoded
+        # but not yet bound to a lane), then queue order — replay preserves
+        # the service order
         drained: list[ClipRequest] = [
             self._inflight[s].req for s in sorted(
                 self._inflight, key=lambda s: self._inflight[s].t_admit
             )
+        ] + [
+            self._tickets[r].req for r in self._staged if r in self._tickets
         ] + list(self._queue)
         arrays: dict[str, np.ndarray] = {}
         manifest = {
@@ -1219,6 +1355,7 @@ class CaptionService:
             "in_flight_steps": {
                 t.req.req_id: t.t for t in self._inflight.values()
             },
+            "staged": list(self._staged),
             "drain_reason": self._drain_reason,
         }
         for i, req in enumerate(drained):

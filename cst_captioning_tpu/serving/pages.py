@@ -11,9 +11,14 @@ arXiv:2604.15464):
   ``mask [N, P]`` — hold N pages of P memory slots each;
 - a **host-side free-list** hands pages out at admission and takes them
   back at completion (allocation is pure Python — no device traffic);
-- a **page table** (host int32 ``[slots, pages_per_row]``) maps each decode
-  lane to its pages; the serving stride gathers the active lanes' pages
-  into the dense ``[B, W, E]`` layout the decode step consumes (one
+- a **device-resident page table** (int32 ``[slots, pages_per_row]`` +
+  per-row lengths, updated by one jitted donated row-set per lane
+  bind/clear) maps each decode lane to its pages. The paged stride kernel
+  (``ops/decode_pallas.fused_decode_stride_paged``) reads pages straight
+  out of the pools by table lookup IN-kernel — no dense bank is ever
+  materialized, so the pool may exceed one batch's dense footprint. The
+  XLA decode path (and the parity oracle) instead runs
+  :func:`gather_bank`, the old dense ``[B, W, E]`` gather (one
   ``jnp.take`` per pool — a device-side copy, no host sync);
 - **page 0 is the shared zero page**: mask 0 everywhere, so table padding
   gathers slots the attention softmax excludes exactly (masked scores hit
@@ -46,6 +51,32 @@ class OutOfPages(RuntimeError):
     pages — it must NOT treat this as a permanent rejection)."""
 
 
+def gather_bank(pools, table):
+    """Dense ``[B, W, *]`` bank from ``(mem, proj, mask)`` pools + a
+    ``[B, width]`` page table — the XLA decode path's fallback and the
+    parity oracle the paged stride kernel is pinned bit-exact against.
+    Page 0 is the shared zero page, so table padding gathers slots the
+    attention softmax excludes exactly."""
+    mem_pool, proj_pool, mask_pool = pools
+    B, width = table.shape
+    P = mem_pool.shape[1]
+    flat = table.reshape(-1)
+    mem = jnp.take(mem_pool, flat, axis=0).reshape(B, width * P, -1)
+    proj = jnp.take(proj_pool, flat, axis=0).reshape(B, width * P, -1)
+    mask = jnp.take(mask_pool, flat, axis=0).reshape(B, width * P)
+    return mem, proj, mask
+
+
+def _bind(table, lens, row, rowv, ln):
+    return table.at[row].set(rowv), lens.at[row].set(ln)
+
+
+# one jitted donated row-set shared by every bank: the device table updates
+# in place at lane bind/clear instead of re-uploading the whole table per
+# stride (the old host-built-table convention)
+_BIND_FN = jax.jit(_bind, donate_argnums=(0, 1))
+
+
 class PageBank:
     """Fixed-size page pool with host free-list + host page table.
 
@@ -69,6 +100,8 @@ class PageBank:
         self.mem = None    # [N+1, P, E]
         self.proj = None   # [N+1, P, A]
         self.mask = None   # [N+1, P]
+        self.row_table = None   # device [rows, width] int32 (init_rows)
+        self.row_lens = None    # device [rows] int32 memory lengths
         self._store_fns: dict[tuple[int, int], object] = {}
         self.pages_hwm = 0  # high-water mark, for the obs gauge
 
@@ -130,6 +163,63 @@ class PageBank:
                 )
             out[i, : len(pages)] = pages
         return out
+
+    # ---- device-resident per-lane page table --------------------------------
+
+    def init_rows(self, rows: int, width: int) -> None:
+        """Materialize the device-resident page table: ``row_table``
+        [rows, width] int32 (row = decode lane, zero-page padded) and
+        ``row_lens`` [rows] int32 per-lane memory lengths. The serving
+        stride passes BOTH straight into the decode program — the paged
+        kernel reads pages by table lookup, the XLA path feeds them to
+        :func:`gather_bank` — so per-stride host uploads shrink to the
+        permutation/masks only."""
+        self.row_table = jnp.zeros((int(rows), int(width)), jnp.int32)
+        self.row_lens = jnp.zeros((int(rows),), jnp.int32)
+
+    def bind_row(self, row: int, owner: Hashable) -> None:
+        """Point table row ``row`` at ``owner``'s pages (one jitted donated
+        row-set; explicit uploads keep the serving loop transfer-guard
+        clean)."""
+        pages = self._owned.get(owner, ())
+        width = self.row_table.shape[1]
+        if len(pages) > width:
+            raise ValueError(
+                f"owner {owner!r} holds {len(pages)} pages > table "
+                f"width {width}"
+            )
+        rowv = np.zeros((width,), np.int32)
+        rowv[: len(pages)] = pages
+        self.row_table, self.row_lens = _BIND_FN(
+            self.row_table, self.row_lens,
+            jax.device_put(np.int32(row)), jax.device_put(rowv),
+            jax.device_put(np.int32(self._lens.get(owner, 0))),
+        )
+
+    def clear_row(self, row: int) -> None:
+        """Reset table row ``row`` to the shared zero page (lane freed)."""
+        width = self.row_table.shape[1]
+        self.row_table, self.row_lens = _BIND_FN(
+            self.row_table, self.row_lens,
+            jax.device_put(np.int32(row)),
+            jax.device_put(np.zeros((width,), np.int32)),
+            jax.device_put(np.int32(0)),
+        )
+
+    def grow_rows(self, rows: int) -> None:
+        """Grow the device table's row count (the lane-pool regrow seam);
+        new rows are born pointing at the zero page."""
+        cur = self.row_table.shape[0]
+        new_r = int(rows)
+        if new_r < cur:
+            raise ValueError(
+                f"grow_rows({rows}) below current row count {cur} — rows "
+                "only grow (shrink = drain and rebuild)"
+            )
+        if new_r == cur:
+            return
+        self.row_table = jnp.pad(self.row_table, ((0, new_r - cur), (0, 0)))
+        self.row_lens = jnp.pad(self.row_lens, ((0, new_r - cur),))
 
     def grow(self, num_pages: int) -> None:
         """Grow the page pool in place (the elastic regrow direction: a

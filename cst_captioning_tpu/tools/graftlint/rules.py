@@ -2561,6 +2561,21 @@ class PallasContractRule(Rule):
                     name_defs: dict) -> list[Finding]:
         env = self._local_env(ctx, call)
         kw = {k.arg: k.value for k in call.keywords if k.arg}
+        nsp = 0
+        gs = self._resolve(kw.get("grid_spec"), env)
+        if isinstance(gs, ast.Call) and _last(_dotted(gs.func)) in (
+                "GridSpec", "PrefetchScalarGridSpec"):
+            # grid_spec sites carry grid/specs/scratch inside the spec
+            # call; PrefetchScalarGridSpec additionally appends its
+            # num_scalar_prefetch operands to every index map's argument
+            # list (after the grid indices)
+            kw = dict(kw)
+            for k in gs.keywords:
+                if k.arg in ("grid", "in_specs", "out_specs",
+                             "scratch_shapes", "num_scalar_prefetch"):
+                    kw[k.arg] = k.value
+            nsp = self._int_of(kw.pop("num_scalar_prefetch", None),
+                               env) or 0
         grid = self._resolve(kw.get("grid"), env)
         if not isinstance(grid, ast.Tuple):
             return []  # no literal grid: nothing checkable single-file
@@ -2597,25 +2612,41 @@ class PallasContractRule(Rule):
             imap = self._resolve(
                 spec.args[1] if len(spec.args) > 1 else None, env
             )
+            mem_space = None
             for k in spec.keywords:
                 if k.arg == "index_map":
                     imap = self._resolve(k.value, env)
+                elif k.arg == "memory_space":
+                    mem_space = _last(_dotted(k.value))
             if isinstance(imap, ast.Lambda):
                 arity = len(imap.args.args) + len(imap.args.posonlyargs)
-                if arity != rank:
+                if arity != rank + nsp:
+                    expect = (
+                        f"the grid rank ({rank}) plus the "
+                        f"{nsp} scalar-prefetch ref(s)" if nsp else
+                        f"the grid rank ({rank})"
+                    )
                     out.append(ctx.finding(
                         self, spec,
                         f"BlockSpec index map takes {arity} argument(s) "
-                        f"but the grid has rank {rank}: pallas passes one "
-                        "program index per grid dim, so this fails at "
-                        "trace time — keep the lambda arity equal to the "
-                        "grid rank",
+                        f"but pallas passes {rank + nsp}: one program "
+                        "index per grid dim"
+                        + (", then each scalar-prefetch ref" if nsp
+                           else "")
+                        + f" — keep the lambda arity equal to {expect}",
                     ))
                 elif not guarded and isinstance(imap.body, ast.Tuple):
                     out.extend(self._divisibility(
                         ctx, spec, shape_node, imap, divisors, env
                     ))
-            nbytes = self._block_nbytes(shape_node, env, dtype="float32")
+            if shape_node is None and mem_space == "ANY":
+                # unblocked whole-array HBM ref (the kernel DMAs slices
+                # itself): nothing resident in VMEM
+                nbytes = 0
+            else:
+                nbytes = self._block_nbytes(
+                    shape_node, env, dtype="float32"
+                )
             if nbytes is None:
                 resolvable = False
             else:
@@ -2780,6 +2811,9 @@ class PallasContractRule(Rule):
         total = 0
         for elt in node.elts:
             elt = self._resolve(elt, env)
+            if isinstance(elt, ast.Attribute) and \
+                    "SemaphoreType" in _dotted(elt):
+                continue  # DMA/REGULAR semaphore: no VMEM footprint
             if not isinstance(elt, ast.Call) or \
                     _last(_dotted(elt.func)) not in ("VMEM", "SMEM"):
                 return None

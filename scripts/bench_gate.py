@@ -147,6 +147,8 @@ def check_file(path: str) -> list[str]:
         _check_rl_async(path, data, errors)
     if name == "BENCH_RL_ONLINE.json":
         _check_rl_online(path, data, errors)
+    if name == "BENCH_SERVING.json":
+        _check_serving(path, data, errors)
     _walk(path, data, errors)
     return errors
 
@@ -209,6 +211,62 @@ def _check_rl_online(path: str, data: dict, errors: list[str]) -> None:
         errors.append(f"{path}: online rung missing staleness_histogram")
     if not isinstance(rung.get("reward_trend"), list):
         errors.append(f"{path}: online rung missing reward_trend")
+
+
+def _check_serving(path: str, data: dict, errors: list[str]) -> None:
+    """The serving ledger's own promises beyond the generic schema: the
+    ``paged_inkernel`` rung ran against its dense-gather reference on both
+    trace shapes (its parity block carries the bit-exact pin —
+    _check_parity then enforces it is true), the per-stride bank-bytes
+    model shows the paged path moving strictly fewer bytes, and the
+    stress config's page high-water mark exceeded the dense-bank
+    footprint the gather path refuses."""
+    paged = data.get("paged")
+    if not isinstance(paged, dict):
+        errors.append(f"{path}: missing the 'paged' rung")
+        return
+    traces = paged.get("traces")
+    if not isinstance(traces, dict) or not traces:
+        errors.append(f"{path}: paged rung missing traces")
+    else:
+        for tname, t in traces.items():
+            for leg in ("paged_inkernel", "dense_gather"):
+                if not isinstance((t or {}).get(leg), dict) or \
+                        "goodput_rps" not in t[leg]:
+                    errors.append(
+                        f"{path}: paged.traces.{tname} missing the "
+                        f"{leg!r} leg"
+                    )
+    parity = paged.get("parity")
+    if not isinstance(parity, dict) or \
+            "paged_vs_gather_bit_exact" not in parity:
+        errors.append(
+            f"{path}: paged rung missing the paged_vs_gather_bit_exact "
+            "parity pin"
+        )
+    bb = paged.get("per_stride_bank_bytes")
+    if not isinstance(bb, dict) or not (
+        isinstance(bb.get("paged_inkernel"), numbers.Real)
+        and isinstance(bb.get("dense_gather"), numbers.Real)
+        and bb["paged_inkernel"] < bb["dense_gather"]
+    ):
+        errors.append(
+            f"{path}: paged.per_stride_bank_bytes must show the paged "
+            "path moving strictly fewer bytes than the dense gather"
+        )
+    stress = paged.get("stress")
+    if not isinstance(stress, dict):
+        errors.append(f"{path}: paged rung missing the stress block")
+    else:
+        hwm = stress.get("pages_hwm")
+        foot = stress.get("dense_footprint_pages")
+        if not (isinstance(hwm, numbers.Real)
+                and isinstance(foot, numbers.Real) and hwm > foot):
+            errors.append(
+                f"{path}: paged.stress pages_hwm = {hwm!r} must exceed "
+                f"dense_footprint_pages = {foot!r} (otherwise the pool "
+                "never held more than one batch's dense-bank worth)"
+            )
 
 
 def main(argv: list[str]) -> int:

@@ -83,8 +83,12 @@ JAX_PLATFORMS=cpu python bench_decode.py --smoke > /dev/null
 JAX_PLATFORMS=cpu python bench_comms.py --smoke > /dev/null
 
 # serving smoke: tiny seeded Poisson+bursty traces through the continuous
-# engine AND the static-batching reference — asserts goodput > 0 and the
-# served-vs-offline bit-parity block (README "Serving")
+# engine AND the static-batching reference — asserts goodput > 0, the
+# served-vs-offline bit-parity block, AND the in-kernel paged-attention
+# gate: the paged_inkernel rung must be token+logprob bit-exact vs its
+# dense-gather twin, and the stress pool's page high-water mark must
+# exceed the dense-bank footprint the gather path refuses (fatal on
+# mismatch — README "Serving")
 JAX_PLATFORMS=cpu python bench_serving.py --smoke > /dev/null
 
 # decoupled-RL smoke: tiny-dims CPU run of the sync/strict/decoupled

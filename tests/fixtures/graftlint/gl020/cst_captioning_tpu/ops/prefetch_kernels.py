@@ -1,0 +1,95 @@
+"""GL020 fixture — ``grid_spec=`` Pallas sites, one contract per def.
+
+``prefetch_ok``: a ``PrefetchScalarGridSpec(num_scalar_prefetch=1)`` site
+whose index maps all take grid-rank + 1 arguments, with unblocked
+``memory_space=pltpu.ANY`` pool refs and a DMA semaphore in scratch —
+quiet (the ANY refs and the semaphore cost no VMEM).
+``prefetch_arity_drift``: same site but one index map forgets the
+trailing scalar-prefetch ref — GL020.
+``gridspec_plain_ok``: a plain ``pltpu.GridSpec`` site (no prefetch)
+with grid-rank index maps — quiet.
+
+Deliberately lint-dirty directory: skipped by the repo-wide walk
+(``fixtures`` is in core._SKIP_DIRS), linted explicitly by the tests.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _paged_copy_kernel(tbl_ref, x_ref, o_ref, pool_ref, slab, sem):
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        pltpu.make_async_copy(
+            pool_ref.at[tbl_ref[0]], slab.at[...], sem
+        ).start()
+        pltpu.make_async_copy(
+            pool_ref.at[tbl_ref[0]], slab.at[...], sem
+        ).wait()
+    o_ref[...] = x_ref[...] + slab[...]
+
+
+def prefetch_ok(x, pool, table, block=128):
+    n, d = x.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // block, d // block),
+        in_specs=[
+            pl.BlockSpec((block, block), lambda i, j, tbl: (i, j)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((block, block),
+                               lambda i, j, tbl: (i, j)),
+        scratch_shapes=[
+            pltpu.VMEM((block, block), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        _paged_copy_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(table, x, pool)
+
+
+def prefetch_arity_drift(x, pool, table, block=128):
+    n, d = x.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // block, d // block),
+        in_specs=[
+            pl.BlockSpec((block, block), lambda i, j: (i, j)),  # GL020
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((block, block),
+                               lambda i, j, tbl: (i, j)),
+        scratch_shapes=[
+            pltpu.VMEM((block, block), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        _paged_copy_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(table, x, pool)
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def gridspec_plain_ok(x, block=128):
+    n, d = x.shape
+    grid_spec = pltpu.GridSpec(
+        grid=(n // block, d // block),
+        in_specs=[pl.BlockSpec((block, block), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((block, block), lambda i, j: (i, j)),
+    )
+    return pl.pallas_call(
+        _copy_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -89,6 +89,101 @@ def test_gate_rejects_missing_online_rung(tmp_path):
     assert _run(tmp_path, bad) == 1
 
 
+def _good_serving() -> dict:
+    """A minimal BENCH_SERVING.json the gate accepts — mirrors the schema
+    bench_serving.py writes (the paged_inkernel rung portion)."""
+    stats = {"completed": 4, "p50_s": 0.1, "p99_s": 0.2, "goodput_rps": 8.0}
+    return {
+        "metric": "serving_request_latency_and_slo_goodput",
+        "device_kind": "cpu",
+        "note": "non-TPU run: rerun on TPU for the flagship numbers",
+        "traces": {
+            "poisson": {"continuous": dict(stats), "static": dict(stats)},
+        },
+        "parity": {"continuous_vs_offline_bit_exact": True},
+        "paged": {
+            "requests_per_trace": 4,
+            "traces": {
+                "poisson": {
+                    "paged_inkernel": dict(stats),
+                    "dense_gather": dict(stats),
+                },
+                "bursty": {
+                    "paged_inkernel": dict(stats),
+                    "dense_gather": dict(stats),
+                },
+            },
+            "per_stride_bank_bytes": {
+                "paged_inkernel": 1000.0,
+                "dense_gather": 3000.0,
+                "bytes_avoided_frac": 0.6667,
+            },
+            "parity": {
+                "paged_vs_gather_bit_exact": True,
+                "checked_requests": 8,
+            },
+            "stress": {
+                "pool_pages": 24,
+                "dense_footprint_pages": 12,
+                "pages_hwm": 20,
+                "completed": 6,
+                "requests": 6,
+            },
+        },
+        "acceptance": {
+            "continuous_beats_static_goodput": {"poisson": True},
+            "paged_matches_dense_gather_bit_exact": True,
+            "paged_pool_exceeds_dense_footprint": True,
+            "gather_path_refuses_oversized_pool": True,
+        },
+    }
+
+
+def _run_serving(tmp_path, data) -> int:
+    (tmp_path / "BENCH_SERVING.json").write_text(json.dumps(data))
+    return bench_gate.main(["bench_gate", str(tmp_path)])
+
+
+def test_gate_accepts_good_serving_ledger(tmp_path):
+    assert _run_serving(tmp_path, _good_serving()) == 0
+
+
+def test_gate_rejects_missing_paged_rung(tmp_path):
+    bad = _good_serving()
+    del bad["paged"]
+    assert _run_serving(tmp_path, bad) == 1
+
+
+def test_gate_rejects_false_paged_parity(tmp_path):
+    bad = _good_serving()
+    bad["paged"]["parity"]["paged_vs_gather_bit_exact"] = False
+    assert _run_serving(tmp_path, bad) == 1
+
+
+def test_gate_rejects_missing_paged_evidence(tmp_path):
+    # each required sub-block missing is a violation on its own
+    for field in ("traces", "parity", "per_stride_bank_bytes", "stress"):
+        bad = _good_serving()
+        del bad["paged"][field]
+        assert _run_serving(tmp_path, bad) == 1, field
+    # a dense_gather leg dropped from a trace
+    bad = _good_serving()
+    del bad["paged"]["traces"]["bursty"]["dense_gather"]
+    assert _run_serving(tmp_path, bad) == 1
+
+
+def test_gate_rejects_paged_not_cheaper_than_gather(tmp_path):
+    bad = _good_serving()
+    bad["paged"]["per_stride_bank_bytes"]["paged_inkernel"] = 3000.0
+    assert _run_serving(tmp_path, bad) == 1
+
+
+def test_gate_rejects_stress_hwm_within_dense_footprint(tmp_path):
+    bad = _good_serving()
+    bad["paged"]["stress"]["pages_hwm"] = 12
+    assert _run_serving(tmp_path, bad) == 1
+
+
 def test_gate_rejects_nontpu_without_note(tmp_path):
     bad = _good_rl_online()
     bad["note"] = None

@@ -2098,7 +2098,10 @@ def test_gl020_arity_divisibility_and_vmem():
     block dim vs grid divisor without a pl.when guard (error), and a
     fully-resolvable VMEM estimate over the ~16 MiB budget (warning);
     the guarded twin and the suppressed twin stay quiet."""
-    findings = _lint_fixture("gl020", ["GL020"])
+    findings = _lint_fixture(
+        "gl020", ["GL020"],
+        only="cst_captioning_tpu/ops/toy_kernels.py",
+    )
     assert _rules_of(findings) == ["GL020"]
     assert all(f.path.endswith("toy_kernels.py") for f in findings)
     by_line = {f.line: f for f in findings}
@@ -2110,6 +2113,21 @@ def test_gl020_arity_divisibility_and_vmem():
     assert by_line[46].severity == "error"
     assert "VMEM" in by_line[76].message and "MiB" in by_line[76].message
     assert by_line[76].severity == "warning"
+
+
+def test_gl020_prefetch_grid_spec_sites():
+    """grid_spec= sites resolve through PrefetchScalarGridSpec/GridSpec:
+    index-map arity must be grid rank + num_scalar_prefetch (the prefetch
+    refs trail the grid indices), unblocked memory_space=ANY refs and DMA
+    semaphores cost no VMEM, and the clean twins stay quiet."""
+    findings = _lint_fixture(
+        "gl020", ["GL020"],
+        only="cst_captioning_tpu/ops/prefetch_kernels.py",
+    )
+    assert _rules_of(findings) == ["GL020"]
+    assert [f.line for f in findings] == [63]
+    assert "scalar-prefetch" in findings[0].message
+    assert findings[0].severity == "error"
 
 
 def test_gl020_opaque_site_provably_cannot():

@@ -908,6 +908,39 @@ def test_report_serving_section_from_synthetic_events(tmp_path):
     assert "queue_wait" in text and "page backpressure" in text
 
 
+def test_report_serving_paged_bank_section(tmp_path):
+    """The serving section surfaces the paged in-kernel attention
+    telemetry: page-table occupancy gauges, encode-ahead staging depth,
+    and the HBM bytes the killed dense-bank gather would have moved."""
+    import os
+
+    from cst_captioning_tpu.obs.report import render_report, report_run
+
+    run = str(tmp_path / "run")
+    _write_stream(
+        os.path.join(run, "events.jsonl"),
+        _proc_events(0.0, 2.0, 0.5,
+                     counters={"serving.requests_submitted": 8,
+                               "serving.requests_admitted": 8,
+                               "serving.requests_completed": 8,
+                               "serving.requests_staged": 3,
+                               "serving.strides": 12,
+                               "serving.gather_bytes_avoided": 6 * 2**20},
+                     gauges={"serving.pages.in_use": 10.0,
+                             "serving.pages.free": 2.0,
+                             "serving.pages.table_rows": 4.0}),
+    )
+    rep = report_run(run)
+    sv = rep["serving"]
+    assert sv["pages"] == {"in_use": 10.0, "free": 2.0, "table_rows": 4.0}
+    assert sv["staged"] == 3
+    assert sv["gather_bytes_avoided"] == 6 * 2**20
+    text = render_report(rep)
+    assert "page table: 10 in use / 2 free over 4 row(s)" in text
+    assert "staged admissions: 3" in text
+    assert "gather bytes avoided: 6.0 MiB" in text
+
+
 def test_report_no_serving_section_without_requests(tmp_path):
     import os
 

@@ -504,6 +504,137 @@ def test_stride_kernel_per_row_mem_lens():
     )
 
 
+def _page_scatter(enc, lens, page_size, width, num_pages, seed=0):
+    """Chop each row's encoder bank into ``page_size``-slot pages scattered
+    over an ``[N+1, P, *]`` pool (row 0 = shared zero page) in a random pool
+    order, returning (mem_pool, proj_pool, mask_pool, table)."""
+    rng = np.random.default_rng(seed)
+    B, M, E = enc.memory.shape
+    A = enc.memory_proj.shape[2]
+    P, W = page_size, width * page_size
+    mem_pool = np.zeros((num_pages + 1, P, E), np.float32)
+    proj_pool = np.zeros((num_pages + 1, P, A), np.float32)
+    mask_pool = np.zeros((num_pages + 1, P), np.float32)
+    table = np.zeros((B, width), np.int32)
+    free = list(rng.permutation(np.arange(1, num_pages + 1)))
+    mem = np.asarray(enc.memory)
+    proj = np.asarray(enc.memory_proj)
+    mask = np.asarray(enc.memory_mask)
+    for b in range(B):
+        L_b = int(lens[b])
+        npg = -(-L_b // P)
+        memb = np.zeros((npg * P, E), np.float32)
+        projb = np.zeros((npg * P, A), np.float32)
+        maskb = np.zeros((npg * P,), np.float32)
+        memb[:L_b] = mem[b, :L_b]
+        projb[:L_b] = proj[b, :L_b]
+        maskb[:L_b] = mask[b, :L_b]
+        for p in range(npg):
+            pg = free.pop()
+            table[b, p] = pg
+            mem_pool[pg] = memb[p * P:(p + 1) * P]
+            proj_pool[pg] = projb[p * P:(p + 1) * P]
+            mask_pool[pg] = maskb[p * P:(p + 1) * P]
+    return (
+        jnp.asarray(mem_pool), jnp.asarray(proj_pool),
+        jnp.asarray(mask_pool), jnp.asarray(table),
+    )
+
+
+@pytest.mark.parametrize("name,n_active", [
+    ("small-2layer", None), ("small-2layer", 3), ("flagship-ish", None),
+    ("flagship-ish", 33),
+])
+def test_paged_stride_bit_exact_vs_dense_gather(name, n_active):
+    """THE paged-attention acceptance pin: fused_decode_stride_paged
+    (in-kernel page-table DMA, no dense bank) vs fused_decode_stride on the
+    _gather_pages dense reference — identical math on identical bytes, so
+    tokens, logprobs AND carry are bit-identical, not merely close. Ragged
+    per-row lens, randomly scattered pool pages, zero-page-padded tails,
+    and a compaction prefix (n_active < B) are all in the sweep."""
+    from cst_captioning_tpu.ops.decode_pallas import (
+        _gather_pages, fused_decode_stride, fused_decode_stride_paged,
+    )
+
+    dims = DIMS[name]
+    model, params, enc, carry, token = _setup(dims, "float32")
+    cell = params["params"]["cell"]
+    G, B = token.shape
+    M = enc.memory.shape[1]
+    S, V = 3, dims["V"]
+    rng = np.random.default_rng(7)
+    lens = np.asarray(
+        [1, M] + list(rng.integers(1, M + 1, size=B - 2)), np.int32
+    )
+    P = 3
+    width = -(-M // P)
+    pool_pages = int(sum(-(-int(l) // P) for l in lens)) + 5
+    mem_pool, proj_pool, mask_pool, table = _page_scatter(
+        enc, lens, P, width, pool_pages, seed=11
+    )
+    from cst_captioning_tpu.decoding.common import (
+        gumbel_step_noise, rollout_step_keys,
+    )
+    noise = jax.vmap(
+        lambda ks: gumbel_step_noise(ks, (B, V), jnp.float32)
+    )(rollout_step_keys(jax.random.key(8), G - 1, S))
+    n = B if n_active is None else n_active
+    finished = jnp.broadcast_to(jnp.arange(B) >= n, (G, B))
+    lens_d = jnp.asarray(lens)
+    kw = dict(steps=S, temperature=0.8, min_len=1,
+              num_layers=dims["L"], block_b=dims["block_b"],
+              block_v=dims["block_v"], mem_lens=lens_d)
+    memg, projg, maskg = _gather_pages(mem_pool, proj_pool, mask_pool, table)
+    c_d, tok_d, lp_d = fused_decode_stride(
+        cell, carry, token, finished, memg, projg, maskg, noise,
+        jnp.int32(0), jnp.int32(n), **kw,
+    )
+    c_p, tok_p, lp_p = fused_decode_stride_paged(
+        cell, carry, token, finished, mem_pool, proj_pool, mask_pool,
+        table, noise, jnp.int32(0), jnp.int32(n), **kw,
+    )
+    np.testing.assert_array_equal(np.asarray(tok_p), np.asarray(tok_d))
+    np.testing.assert_array_equal(np.asarray(lp_p), np.asarray(lp_d))
+    for a, b in zip(jax.tree.leaves(c_p), jax.tree.leaves(c_d)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_paged_stride_validates_operands():
+    """Malformed paged operands fail loudly at the wrapper, not deep in
+    lowering: a 3-D page table, a 2-D mem pool, and a noise block whose lane
+    axis disagrees with G are each rejected."""
+    from cst_captioning_tpu.ops.decode_pallas import fused_decode_stride_paged
+
+    dims = DIMS["small-2layer"]
+    model, params, enc, carry, token = _setup(dims, "float32")
+    cell = params["params"]["cell"]
+    G, B = token.shape
+    M = enc.memory.shape[1]
+    S, V = 2, dims["V"]
+    lens = np.full((B,), M, np.int32)
+    mem_pool, proj_pool, mask_pool, table = _page_scatter(
+        enc, lens, 3, -(-M // 3), B * -(-M // 3) + 2
+    )
+    finished = jnp.zeros((G, B), bool)
+    noise = jnp.zeros((S, G - 1, B, V), jnp.float32)
+    kw = dict(steps=S, num_layers=dims["L"])
+    with pytest.raises(ValueError, match="page_table"):
+        fused_decode_stride_paged(
+            cell, carry, token, finished, mem_pool, proj_pool, mask_pool,
+            table[None], noise, jnp.int32(0), **kw,
+        )
+    with pytest.raises(ValueError, match="pool"):
+        fused_decode_stride_paged(
+            cell, carry, token, finished, mem_pool[:, :, 0], proj_pool,
+            mask_pool, table, noise, jnp.int32(0), **kw,
+        )
+    with pytest.raises(ValueError, match="noise"):
+        fused_decode_stride_paged(
+            cell, carry, token, finished, mem_pool, proj_pool, mask_pool,
+            table, noise[:, :1, :1], jnp.int32(0), **kw,
+        )
+
+
 # ---------------------------------------------------------------------------
 # fused beam step (decode + in-kernel top-W candidate selection)
 # ---------------------------------------------------------------------------

@@ -244,6 +244,82 @@ def test_single_request_larger_than_pool_raises(setup):
         svc.serve(_requests(frames=(8,)))
 
 
+# ---- paged in-kernel attention: pool past the dense footprint ---------------
+
+
+def test_paged_pool_exceeds_dense_footprint_with_staging(setup):
+    """THE paged acceptance pin: a pool of 20 pages over 2 lanes x 4
+    pages/row (dense footprint 8) actually FILLS — encode-ahead staging
+    parks encoded requests' pages while lanes are busy, the high-water
+    mark exceeds what any dense [B, W, E] bank could hold, and every
+    request is still token- and logprob-bit-identical to its offline
+    decode. The dense-gather path refuses the same pool at construction
+    — the in-kernel page reader is what makes the surplus admissible."""
+    model, params = setup
+    m_pal = CaptionModel(dataclasses.replace(
+        model.cfg, decode_impl="pallas", decode_stride=4,
+    ))
+    reqs = _requests(frames=(8,) * 8, seed0=9000)
+    svc = CaptionService(
+        m_pal, params, capacity=2, num_rollouts=1, stride=4, frame_bucket=1,
+        page_size=2, num_pages=20,
+    )
+    assert svc.paged and svc.B * svc.table_width == 8
+    report = svc.serve(reqs)
+    assert report.completed == len(reqs) and not report.drained
+    # the pool genuinely held more than one batch's dense-bank worth
+    assert svc.bank.pages_hwm > svc.B * svc.table_width
+    _assert_parity(m_pal, params, report, reqs, K=1)
+    assert svc.bank.pages_in_use == 0 and len(svc._free_slots) == 2
+    # the same pool is impossible on the gather path: it re-materializes
+    # every lane's full window per stride, so surplus pages never admit
+    with pytest.raises(ValueError, match="dense-bank footprint"):
+        CaptionService(model, params, capacity=2, num_rollouts=1,
+                       stride=4, frame_bucket=1, page_size=2, num_pages=20)
+
+
+def test_paged_requires_kernel_path(setup):
+    """paged=True without the stride kernel is a loud constructor error —
+    the XLA decode has no in-kernel page reader to honor it."""
+    model, params = setup
+    with pytest.raises(ValueError, match="decode_impl='pallas'"):
+        CaptionService(model, params, capacity=2, num_rollouts=1,
+                       paged=True)
+
+
+def test_paged_hot_swap_midflight_parity(setup):
+    """Cross-version strides on the paged path: a publish straddling live
+    traffic dispatches one masked paged stride per live version, and every
+    request stays bit-identical to its offline decode under its
+    admission-pinned params."""
+    model, params = setup
+    m_pal = CaptionModel(dataclasses.replace(
+        model.cfg, decode_impl="pallas", decode_stride=4,
+    ))
+    p2 = _perturbed(params)
+    reqs = _requests()
+    svc = CaptionService(m_pal, params, capacity=2, num_rollouts=2,
+                         stride=4, frame_bucket=2)
+    assert svc.paged
+    published = []
+
+    def feedback(req, result, version):
+        if not published:
+            published.append(svc.publish_params(p2, version=1))
+
+    svc._feedback = feedback
+    report = svc.serve(reqs)
+    assert published == [True]
+    assert report.completed == len(reqs) and not report.drained
+    by_ver = {0: [], 1: []}
+    for req in reqs:
+        by_ver[report.results[req.req_id].param_version].append(req)
+    assert by_ver[0] and by_ver[1]
+    _assert_parity(m_pal, params, report, by_ver[0])
+    _assert_parity(m_pal, p2, report, by_ver[1])
+    assert svc._old_params == {}
+
+
 def test_npad_best_lane_selection(setup):
     """NPAD anytime-quality: the served caption is the best-scoring lane,
     so its total logprob is >= the greedy lane's by construction, and the
