@@ -1,0 +1,256 @@
+"""Scaling bench: dp weak-scaling ledger + flagship-XL mp rungs.
+
+BENCH_SCALING.json historically carried the RL weak-scaling ladder
+(per-chip clips/s at 1/2/4/8 virtual CPU devices). This bench becomes its
+producer: it PRESERVES that committed dp block (``points`` + ``summary``
+— re-measuring it is bench_rl_async.py territory) and adds the model-
+parallel rungs the flagship-XL refactor introduces:
+
+- ``mp=1``  — the replicated stride composite (the exact program
+  ops/decode_pallas._reference_stride pins), jitted on one device;
+- ``mp=2``  — ops/decode_mp.mp_decode_stride on a 2-shard 'mp' mesh of
+  virtual CPU devices: each shard runs the decode over its vocab slice,
+  selection/logsumexp merge cross-shard.
+
+The in-run parity gate asserts the mp=2 stride tokens are BIT-exact vs
+mp=1 (logprobs within a few f32 ulps — the documented reassociation
+allowance) and the mp=2 beam candidates are candidate-for-candidate
+identical; the rungs ledger both the ANALYTIC merge bytes per stride
+step (emb psum + (m,s) logsumexp merge + selected-logit psum + argmax
+all-gathers) and the embedding-gradient dp-allreduce bytes under mp
+sharding (parallel/comms.ledger mp_devices accounting).
+
+Weak-scaling caveat (same as the dp summary's): both "shards" of the
+mp=2 rung share this host's cores, so raw steps/s conflates core
+contention with merge cost — the analytic bytes are the honest scaling
+signal; NOT absolute TPU throughput.
+
+Usage: python bench_scaling.py [--smoke] [--steps N] [--json PATH]
+  --smoke   tiny dims, parity gate only, no BENCH_SCALING.json unless
+            --json given — the CPU functional gate scripts/lint.sh runs
+            (JAX_PLATFORMS=cpu)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# the mp mesh needs devices: force 8 fake CPU devices BEFORE jax's backend
+# initializes (no-op for the TPU backend)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax                                        # noqa: E402
+import jax.numpy as jnp                           # noqa: E402
+import numpy as np                                # noqa: E402
+
+from cst_captioning_tpu.config.config import ModelConfig       # noqa: E402
+from cst_captioning_tpu.models import CaptionModel             # noqa: E402
+from cst_captioning_tpu.ops.decode_mp import (                 # noqa: E402
+    mp_beam_step,
+    mp_decode_stride,
+)
+from cst_captioning_tpu.ops.decode_pallas import (             # noqa: E402
+    _reference_beam_topk,
+    _reference_stride,
+)
+from cst_captioning_tpu.parallel.comms import ledger           # noqa: E402
+from cst_captioning_tpu.train.mesh import make_mesh            # noqa: E402
+
+
+def _setup(V: int, B: int, d: int, F: int, K: int, seed: int = 0):
+    cfg = ModelConfig(
+        vocab_size=V, modalities=(("resnet", 16),), d_embed=d, d_hidden=d,
+        d_att=max(4, d // 2), encoder="temporal_attention", dropout=0.0,
+        max_len=8, max_frames=F, dtype="float32", num_layers=1,
+    )
+    model = CaptionModel(cfg)
+    rng = np.random.default_rng(seed)
+    feats = {"resnet": jnp.asarray(rng.normal(size=(B, F, 16)), jnp.float32)}
+    masks = {"resnet": jnp.asarray(
+        np.arange(F)[None] < rng.integers(2, F + 1, size=(B, 1)), jnp.float32
+    )}
+    labels = jnp.asarray(rng.integers(4, V, size=(B, 8)), jnp.int32)
+    params = model.init(jax.random.key(0), feats, masks, labels)
+    enc = model.apply(params, feats, masks, method=CaptionModel.encode)
+    G = 1 + K
+    carry = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (G,) + x.shape), enc.carry
+    )
+    token = jnp.full((G, B), 1, jnp.int32)
+    return model, params, enc, carry, token, rng
+
+
+def merge_bytes_per_step(G: int, B: int, E: int, mp: int,
+                         emb_bytes: int = 4) -> dict:
+    """Analytic cross-shard bytes of ONE sharded stride step, per device:
+    the embedding psum, the (m, s) logsumexp merge + selected-logit psum,
+    and the two (value, index) argmax all-gathers."""
+    emb_psum = G * B * E * emb_bytes
+    lse_merge = 3 * G * B * 4            # pmax(m) + psum(s) + psum(selected)
+    argmax_gathers = 2 * mp * G * B * 4  # all_gather of values + indices
+    return {
+        "emb_psum": emb_psum,
+        "lse_and_select": lse_merge,
+        "argmax_all_gather": argmax_gathers,
+        "total": emb_psum + lse_merge + argmax_gathers,
+    }
+
+
+def _time(fn, reps: int) -> float:
+    fn()  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def run_mp_block(V: int, B: int, d: int, F: int, K: int, S: int,
+                 reps: int) -> dict:
+    model, params, enc, carry, token, rng = _setup(V, B, d, F, K)
+    cell = params["params"]["cell"]
+    G = 1 + K
+    finished = jnp.zeros((G, B), bool)
+    noise = jnp.asarray(rng.gumbel(size=(S, K, B, V)), jnp.float32)
+    t0 = jnp.asarray(0, jnp.int32)
+    temperature, min_len = 0.7, 2
+
+    ref = jax.jit(lambda c, tk, n: _reference_stride(
+        cell, c, tk, finished, enc.memory, enc.memory_proj, enc.memory_mask,
+        n, t0, steps=S, temperature=temperature, min_len=min_len,
+    ))
+    c_r, tok_r, lp_r = ref(carry, token, noise)
+
+    mesh = make_mesh(num_devices=2, mp_devices=2)
+    mp = mesh.shape["mp"]
+    c_m, tok_m, lp_m = mp_decode_stride(
+        cell, carry, token, finished, enc.memory, enc.memory_proj,
+        enc.memory_mask, noise, t0, mesh=mesh, steps=S,
+        temperature=temperature, min_len=min_len,
+    )
+    stride_tokens_exact = bool(
+        (np.asarray(tok_m) == np.asarray(tok_r)).all()
+    )
+    lp_diff = float(np.abs(np.asarray(lp_m) - np.asarray(lp_r)).max())
+
+    # beam: one sharded step vs the replicated composite
+    W = min(3, V // mp)
+    carry_b = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), enc.carry
+    )
+    token_b = jnp.full((W, B), 1, jnp.int32)
+    fin_b = jnp.zeros((W, B), bool).at[W - 1].set(True)
+    scores = jnp.asarray(rng.normal(size=(W, B)), jnp.float32)
+    t = jnp.asarray(1, jnp.int32)
+    _cb, ts_r, fl_r = _reference_beam_topk(
+        cell, carry_b, token_b, fin_b, scores, enc.memory, enc.memory_proj,
+        enc.memory_mask, t=t, min_len=min_len,
+    )
+    _cm, ts_m, fl_m = mp_beam_step(
+        cell, carry_b, token_b, fin_b, scores, enc.memory, enc.memory_proj,
+        enc.memory_mask, mesh=mesh, t=1, min_len=min_len,
+    )
+    beam_flat_exact = bool((np.asarray(fl_m) == np.asarray(fl_r)).all())
+    beam_score_diff = float(
+        np.abs(np.asarray(ts_m) - np.asarray(ts_r)).max()
+    )
+
+    sec_ref = _time(lambda: ref(carry, token, noise), reps)
+    sec_mp = _time(lambda: mp_decode_stride(
+        cell, carry, token, finished, enc.memory, enc.memory_proj,
+        enc.memory_mask, noise, t0, mesh=mesh, steps=S,
+        temperature=temperature, min_len=min_len,
+    ), reps)
+
+    # embedding-grad dp-allreduce bytes under mp sharding (comms ledger)
+    led_1 = ledger(params, None)
+    led_mp = ledger(params, None, mp_devices=mp)
+
+    return {
+        "metric": "mp_stride_seconds_per_stride_cpu_mesh",
+        "dims": {"V": V, "B": B, "d": d, "frames": F, "lanes": G,
+                 "steps": S},
+        "rungs": [
+            {"mp": 1, "seconds_per_stride": round(sec_ref, 5),
+             "strides_per_sec": round(1.0 / sec_ref, 2)},
+            {"mp": mp, "seconds_per_stride": round(sec_mp, 5),
+             "strides_per_sec": round(1.0 / sec_mp, 2),
+             "merge_bytes_per_step_per_device":
+                 merge_bytes_per_step(G, B, d, mp)},
+        ],
+        "parity": {
+            "stride_tokens_bit_exact": stride_tokens_exact,
+            "beam_candidates_bit_exact": beam_flat_exact,
+            "stride_logprob_max_abs_diff": lp_diff,
+            "beam_score_max_abs_diff": beam_score_diff,
+        },
+        "embedding_grad_ledger": {
+            "mp1_bytes_on_wire_per_update":
+                led_1["bytes_on_wire_per_update"],
+            "mp2_bytes_on_wire_per_update":
+                led_mp["bytes_on_wire_per_update"],
+        },
+        "device_kind": jax.devices()[0].device_kind,
+        "note": (
+            "mp weak scaling on forced-CPU virtual devices sharing this "
+            "host's core(s): raw seconds conflate core contention with "
+            "merge cost — the analytic merge bytes and the embedding-grad "
+            "ledger are the honest scaling signal. NOT absolute TPU "
+            "throughput."
+        ),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny dims, parity gate only, no JSON write "
+                         "unless --json given")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="stride length (default 6; smoke 4)")
+    ap.add_argument("--json", default="",
+                    help="output path (default BENCH_SCALING.json; smoke "
+                         "writes none)")
+    args = ap.parse_args()
+
+    if args.smoke:
+        dims = dict(V=32, B=4, d=12, F=5, K=2, S=args.steps or 4, reps=1)
+    else:
+        dims = dict(V=256, B=16, d=64, F=10, K=4, S=args.steps or 6,
+                    reps=3)
+    block = run_mp_block(**dims)
+
+    gate_ok = (block["parity"]["stride_tokens_bit_exact"]
+               and block["parity"]["beam_candidates_bit_exact"]
+               and block["parity"]["stride_logprob_max_abs_diff"] < 1e-5)
+    print(json.dumps({"mp": {k: block[k] for k in
+                             ("metric", "rungs", "parity")}}, indent=2))
+    if not gate_ok:
+        print("bench_scaling: PARITY GATE FAILED", file=sys.stderr)
+        sys.exit(1)
+
+    path = args.json or ("" if args.smoke else "BENCH_SCALING.json")
+    if path:
+        out = {}
+        if os.path.exists(path):
+            # preserve the committed dp weak-scaling block — this bench
+            # only owns the mp rungs
+            with open(path, encoding="utf-8") as f:
+                out = json.load(f)
+        out["mp"] = block
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        print(f"bench_scaling: wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -62,7 +62,8 @@ def main(argv: list[str] | None = None) -> None:
     mesh = None
     if n_dev > 1 or cfg.mesh.seq_devices > 1:
         mesh = make_mesh(cfg.mesh.num_devices,
-                         seq_devices=cfg.mesh.seq_devices)
+                         seq_devices=cfg.mesh.seq_devices,
+                         mp_devices=cfg.mesh.mp_devices)
         params = replicate(mesh, params)
 
     # multi-host: every process computes the full result (the caption gather

@@ -471,6 +471,11 @@ class MeshConfig:
     # collective attention softmax (long-context path, SURVEY.md §5); must
     # divide num_devices and model.max_frames
     seq_devices: int = 1
+    # >1: 2-D ('data','mp') mesh — flagship-XL model parallelism: the vocab
+    # head / embedding (and the training-side LSTM gates) shard over 'mp'
+    # per train/mesh.MP_PARAM_PARTITION_RULES; must divide the device count
+    # and model.vocab_size / model.d_hidden. Exclusive with seq_devices > 1.
+    mp_devices: int = 1
 
 
 @dataclass(frozen=True)
@@ -569,6 +574,40 @@ class ExperimentConfig:
                 "path: its gradients are computed outside shard_map and "
                 "never ride a grad allreduce"
             )
+        if self.mesh.mp_devices < 1:
+            raise ValueError(
+                f"mesh.mp_devices {self.mesh.mp_devices} must be >= 1 "
+                "(1 = no model parallelism)"
+            )
+        if self.mesh.mp_devices > 1:
+            if self.mesh.seq_devices > 1:
+                # both want the second mesh dimension; a 3-D
+                # ('data','seq','mp') composition needs an SP-aware vocab
+                # shard story first (ROADMAP flagship-XL residuals)
+                raise ValueError(
+                    "mesh.mp_devices > 1 cannot compose with the "
+                    "sequence-parallel ('seq_devices > 1') path yet — "
+                    "pick one second mesh axis"
+                )
+            if self.model.vocab_size % self.mesh.mp_devices:
+                raise ValueError(
+                    f"mesh.mp_devices {self.mesh.mp_devices} must divide "
+                    f"model.vocab_size {self.model.vocab_size} (the vocab "
+                    "head and embedding shard in equal slices)"
+                )
+            if self.model.d_hidden % self.mesh.mp_devices:
+                raise ValueError(
+                    f"mesh.mp_devices {self.mesh.mp_devices} must divide "
+                    f"model.d_hidden {self.model.d_hidden} (the LSTM gate "
+                    "matrices shard in equal columns)"
+                )
+            if (self.mesh.num_devices
+                    and self.mesh.num_devices % self.mesh.mp_devices):
+                raise ValueError(
+                    f"mesh.mp_devices {self.mesh.mp_devices} must divide "
+                    f"mesh.num_devices {self.mesh.num_devices} (the mesh "
+                    "is a dense data x mp grid)"
+                )
 
     # ---- serialization ----------------------------------------------------
 

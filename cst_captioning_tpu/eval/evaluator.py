@@ -37,14 +37,18 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cst_captioning_tpu import obs
-from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.config.config import EvalConfig
 from cst_captioning_tpu.data.batcher import Batcher
 from cst_captioning_tpu.data.dataset import CaptionDataset
 from cst_captioning_tpu.decoding import beam_search, greedy_decode, npad_decode
 from cst_captioning_tpu.metrics.scorer import CaptionScorer
 from cst_captioning_tpu.metrics.tokenizer import ptb_tokenize
-from cst_captioning_tpu.parallel import sp_batch_specs, sp_model
+from cst_captioning_tpu.parallel import (
+    CompilePlan,
+    compile_fn,
+    sp_batch_specs,
+    sp_model,
+)
 from cst_captioning_tpu.train import multihost
 from cst_captioning_tpu.train.mesh import batch_sharding
 from cst_captioning_tpu.train.steps import batch_arrays
@@ -145,6 +149,7 @@ class Evaluator:
                 dec_model, p, f, m, max_len=T, min_len=ml, batch_axes=bx
             )[0]
         self._fm_shardings = None
+        plan = CompilePlan()
         if mesh is not None:
             if self.sp:
                 f_spec, m_spec = sp_batch_specs(model.cfg, "data")
@@ -157,13 +162,10 @@ class Evaluator:
                 in_specs = (P(), P("data"), P("data"), P())
                 s = batch_sharding(mesh)
                 self._fm_shardings = (s, s)
-            decode = shard_map(
-                decode,
-                mesh=mesh,
-                in_specs=in_specs,
-                out_specs=P("data"),
+            plan = CompilePlan(
+                mesh=mesh, in_specs=in_specs, out_specs=P("data")
             )
-        self._decode = jax.jit(decode)
+        self._decode = compile_fn(decode, plan)
 
     def _dispatch(self, params, batch, bi: int):
         """Collate-upload batch ``bi`` and launch its decode (async)."""

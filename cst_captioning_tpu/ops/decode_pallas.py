@@ -108,16 +108,22 @@ def _lstm_math(x, c, h, wi, wh, b):
 
 
 def _reference(cell_params, carry, token, memory, memory_proj, memory_mask,
-               mem_lens=None):
+               mem_lens=None, emb=None):
     """The decode step as a plain-jnp composite over the cell's param tree
     (f32 compute, like the kernel) — the interpret-mode shard_map fallback
     and the parity oracle's cross-check. ``mem_lens`` [B] excludes each
     row's memory columns >= its length from the softmax ENTIRELY (the
-    per-row raggedness contract of the stride kernel below)."""
+    per-row raggedness contract of the stride kernel below). ``emb``
+    bypasses the embedding gather with pre-gathered rows — the
+    vocab-sharded path (ops/decode_mp.py) gathers from its LOCAL embedding
+    rows and psums, so a global-id gather here would be wrong there."""
     L = _num_layers(cell_params)
-    emb = jnp.asarray(
-        cell_params["word_embed"]["embedding"]
-    )[token].astype(jnp.float32)
+    if emb is None:
+        emb = jnp.asarray(
+            cell_params["word_embed"]["embedding"]
+        )[token].astype(jnp.float32)
+    else:
+        emb = emb.astype(jnp.float32)
     wq = cell_params["attention"]["query_proj"]["kernel"].astype(jnp.float32)
     bq = cell_params["attention"]["query_proj"]["bias"].astype(jnp.float32)
     v = cell_params["attention"]["score"]["kernel"][:, 0].astype(jnp.float32)
@@ -351,36 +357,45 @@ def _fused_call(cell_params, carry, emb, memory, memory_proj, memory_mask,
 
 def fused_decode_step(cell_params, carry, token, memory, memory_proj,
                       memory_mask, num_layers: int | None = None,
-                      block_b: int = 32, block_v: int = 1024):
+                      block_b: int = 32, block_v: int = 1024, emb=None):
     """Fused decode step -> (new_carry, logits [G, B, V] f32).
 
     Args: ``cell_params`` — the DecoderCell param subtree
     (``params["params"]["cell"]``); ``carry`` — tuple over layers of
     (c, h), leaves [G, B, H]; ``token`` [G, B] int32; ``memory`` [B, M, E] /
     ``memory_proj`` [B, M, A] / ``memory_mask`` [B, M] shared by all G
-    lanes. Inference-only: no VJP is defined (decode never takes gradients).
+    lanes. ``emb`` [G, B, E] (optional) skips the internal embedding
+    gather — the vocab-sharded caller (ops/decode_mp.py) supplies the
+    psum-merged rows because its local table only holds a vocab slice.
+    Inference-only: no VJP is defined (decode never takes gradients).
     """
     if num_layers is not None and num_layers != _num_layers(cell_params):
         raise ValueError(
             f"num_layers {num_layers} does not match the "
             f"{_num_layers(cell_params)} lstm layers in cell_params"
         )
-    # the embed gather stays an XLA op (module docstring: keeping the [V, E]
-    # table out of VMEM is what buys the other weights residency).
-    # jnp.asarray: params may arrive as host numpy (a device_get'd
-    # checkpoint), whose __getitem__ rejects traced token indices
-    emb = jnp.asarray(cell_params["word_embed"]["embedding"])[token]
+    if emb is None:
+        # the embed gather stays an XLA op (module docstring: keeping the
+        # [V, E] table out of VMEM is what buys the other weights residency).
+        # jnp.asarray: params may arrive as host numpy (a device_get'd
+        # checkpoint), whose __getitem__ rejects traced token indices
+        emb = jnp.asarray(cell_params["word_embed"]["embedding"])[token]
     interpret = jax.default_backend() != "tpu"
+    # cell_params join the check: under the vocab-sharded shard_map
+    # (ops/decode_mp.py) the activations are all invariant (emb arrives
+    # psum-merged) but out_proj/word_embed vary over 'mp'
     if interpret and any(
         vma_of(x)
         for x in (emb, memory, memory_proj, memory_mask,
-                  *jax.tree.leaves(carry))
+                  *jax.tree.leaves(carry),
+                  *jax.tree.leaves(cell_params))
     ):
         # Pallas interpret mode can't run under a varying-axis-checked
         # shard_map — fall back to the composite (CPU tests only; compiled
         # Mosaic on TPU runs the kernel in every context)
         return _reference(
-            cell_params, carry, token, memory, memory_proj, memory_mask
+            cell_params, carry, token, memory, memory_proj, memory_mask,
+            emb=emb,
         )
     return _fused_call(
         cell_params, carry, emb, memory, memory_proj, memory_mask,

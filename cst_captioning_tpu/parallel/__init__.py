@@ -7,11 +7,18 @@ lives frame-sharded across the mesh and the only frame-crossing reductions
 (attention softmax, pooled carry init) run as XLA collectives over ICI.
 """
 
+from cst_captioning_tpu.parallel.compile import (
+    CompileError,
+    CompilePlan,
+    compile_fn,
+    partition,
+)
 from cst_captioning_tpu.parallel.comms import (
     Bucket,
     BucketPlan,
     CommConfig,
     ledger,
+    mp_shard_view,
     per_leaf_f32_bytes,
     plan_buckets,
     reduce_tree,
@@ -38,6 +45,10 @@ __all__ = [
     "Bucket",
     "BucketPlan",
     "CommConfig",
+    "CompileError",
+    "CompilePlan",
+    "compile_fn",
+    "partition",
     "SubmeshPlan",
     "grow_actors",
     "largest_divisor",
@@ -46,6 +57,7 @@ __all__ = [
     "shared_plan",
     "shrink_actors",
     "make_sp_decode",
+    "mp_shard_view",
     "per_leaf_f32_bytes",
     "plan_buckets",
     "reduce_tree",

@@ -116,6 +116,41 @@ def _family_rank(path: str) -> int:
     return len(PARAM_PARTITION_RULES)
 
 
+def _mp_sharded(path: str) -> bool:
+    """Whether MP_PARAM_PARTITION_RULES puts this param on the 'mp' axis
+    (flagship-XL: the vocab/out-projection and LSTM gate families)."""
+    from cst_captioning_tpu.train.mesh import MP_PARAM_PARTITION_RULES
+
+    for _family, pattern, spec in MP_PARAM_PARTITION_RULES:
+        if re.fullmatch(pattern, path):
+            return any(a == "mp" for a in spec if a is not None)
+    return False
+
+
+def mp_shard_view(tree, mp_devices: int):
+    """The dp-allreduce payload shape under mp sharding, as a ShapeDtype
+    pytree: every mp-sharded leaf carries 1/mp of its elements per device
+    (the embedding gradient under a row-sharded table stays DENSE — each
+    shard reduces its own [V/mp, E] block, never a scatter of sparse
+    rows — so it buckets exactly like any other leaf). Host-side analytic
+    view for :func:`ledger`; identity at ``mp_devices<=1``."""
+    import jax
+
+    if mp_devices <= 1:
+        return tree
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    paths = param_path_names(tree)
+    out = []
+    for path, leaf in zip(paths, leaves):
+        if _mp_sharded(path):
+            out.append(jax.ShapeDtypeStruct(
+                (-(-leaf.size // mp_devices),), leaf.dtype
+            ))
+        else:
+            out.append(jax.ShapeDtypeStruct(leaf.shape, leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def _wire_dtype_of(leaf, comm: CommConfig):
     """The on-wire dtype for one leaf (host-side; works on tracers and
     ShapeDtypeStructs alike — only ``.dtype`` is read)."""
@@ -235,12 +270,19 @@ def reduce_tree(grads, axis: str, comm: CommConfig | None):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def ledger(tree, comm: CommConfig | None, reductions: int = 1) -> dict:
+def ledger(tree, comm: CommConfig | None, reductions: int = 1,
+           mp_devices: int = 1) -> dict:
     """Host-side bytes-on-wire accounting for one update that reduces a
     ``tree``-shaped payload ``reductions`` times (1 for the fused/chunked
     unoverlapped update; chunks+1 for the overlapped chunked update, which
     reduces every chunk's param-shaped grads plus the encoder cotangent
-    fold) — the BENCH_COMMS.json row shape."""
+    fold) — the BENCH_COMMS.json row shape.
+
+    ``mp_devices>1`` accounts the flagship-XL dp-allreduce: mp-sharded
+    leaves (embedding, vocab projection, LSTM gates) reduce only their
+    local 1/mp block per device (:func:`mp_shard_view`) — the mp=1 numbers
+    are bit-identical to the pre-mp ledger."""
+    tree = mp_shard_view(tree, mp_devices)
     if comm is None:
         import jax
 

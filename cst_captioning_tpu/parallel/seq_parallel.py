@@ -25,7 +25,6 @@ batch over 'data' and frames over 'seq'; the XE step psums the loss over
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Callable
 
 import jax
@@ -33,8 +32,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.config.config import ModelConfig
+from cst_captioning_tpu.parallel.compile import CompilePlan, compile_fn, partition
 from cst_captioning_tpu.decoding import fused_decode, greedy_decode, sample_decode
 from cst_captioning_tpu.losses import masked_cross_entropy
 from cst_captioning_tpu.models import CaptionModel
@@ -74,13 +73,11 @@ def make_sp_forward(model: CaptionModel, mesh: Mesh, data_axis: str = "",
     def fwd(params, feats, masks, labels):
         return model.apply(params, feats, masks, labels)
 
-    sharded = shard_map(
-        fwd,
+    return compile_fn(fwd, CompilePlan(
         mesh=mesh,
         in_specs=(P(), f_spec, m_spec, P(b)),
         out_specs=P(b),
-    )
-    return jax.jit(sharded)
+    ))
 
 
 def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
@@ -147,13 +144,11 @@ def make_sp_decode(model: CaptionModel, mesh: Mesh, num_rollouts: int = 0,
             samples = greedy  # stable output structure for jit
         return greedy, samples
 
-    sharded = shard_map(
-        dec,
+    return compile_fn(dec, CompilePlan(
         mesh=mesh,
         in_specs=(P(), f_spec, m_spec, P()),
         out_specs=(P(b), P(None, b) if num_rollouts else P(b)),
-    )
-    return jax.jit(sharded)
+    ))
 
 
 def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
@@ -205,14 +200,12 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
             den = jax.lax.psum(den, data_axis)
         return num / jnp.maximum(den, 1.0)
 
-    sm = shard_map(
-        sharded_loss,
+    sm = partition(sharded_loss, CompilePlan(
         mesh=mesh,
         in_specs=(P(), f_spec, m_spec, P(b), P(b), P(b), P()),
         out_specs=P(),
-    )
+    ))
 
-    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: TrainState, feats, masks, labels, mask, weights):
         drng = jax.random.fold_in(state.rng, state.step)
 
@@ -223,7 +216,9 @@ def make_sp_xe_step(model: CaptionModel, mesh: Mesh,
         gnorm = optax.global_norm(grads)
         return _apply(state, grads, loss, gnorm, guard, stats=stats)
 
-    return step
+    return compile_fn(
+        step, CompilePlan(donate_argnums=(0,) if donate else ())
+    )
 
 
 def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
@@ -307,7 +302,6 @@ def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
             den = jax.lax.psum(den, data_axis)
         return num, den
 
-    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def update(state: TrainState, feats, masks, samples, advantage, valid):
         K = samples.shape[0]
 
@@ -315,17 +309,17 @@ def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
         # collective transposes produce exact global grads — frame-sharded
         # params sum their partials, replicated-path params stay exact
         def enc_fn(p):
-            return shard_map(
-                sharded_encode, mesh=mesh,
+            return partition(sharded_encode, CompilePlan(
+                mesh=mesh,
                 in_specs=(P(), f_spec, m_spec), out_specs=enc_spec,
-            )(p, feats, masks)
+            ))(p, feats, masks)
 
         def sums(p, e, sam_c, adv_c):
-            return shard_map(
-                sharded_sums, mesh=mesh,
+            return partition(sharded_sums, CompilePlan(
+                mesh=mesh,
                 in_specs=(P(), enc_spec, P(None, b), P(None, b), P(b)),
                 out_specs=(P(), P()),
-            )(p, e, sam_c, adv_c, valid)
+            ))(p, e, sam_c, adv_c, valid)
 
         if chunks > 1:
             if K % chunks:
@@ -380,7 +374,9 @@ def make_sp_rl_update(model: CaptionModel, mesh: Mesh, data_axis: str = "data",
         return _apply(state, grads, loss, gnorm, guard, key="rl_loss",
                       stats=stats)
 
-    return update
+    return compile_fn(
+        update, CompilePlan(donate_argnums=(0,) if donate else ())
+    )
 
 
 def sp_batch_shardings(mesh: Mesh, cfg: ModelConfig, data_axis: str = "data",

@@ -89,10 +89,19 @@ def plan_submesh(
     both sides keep >= 1, then each side clamps down to the largest
     divisor of ``batch_size`` (0 = no batch constraint). A mesh with a
     single device returns the shared plan.
+
+    A 2-D ``(data, mp)`` mesh (flagship-XL, train/mesh.make_mesh with
+    ``mp_devices>1``) splits along its DATA rows: each side keeps every mp
+    column, so both submeshes stay 2-D and the mp-sharded decode/update
+    factories run on either side unchanged — the dp x mp composition seam.
+    ``axis`` is ignored on that path (axis names come from the mesh).
     """
+    if len(mesh.axis_names) == 2:
+        return _plan_submesh_2d(mesh, actor_fraction, batch_size)
     if len(mesh.axis_names) != 1:
         raise ValueError(
-            f"plan_submesh needs a 1-D mesh, got axes {mesh.axis_names!r}"
+            f"plan_submesh needs a 1-D or 2-D mesh, got axes "
+            f"{mesh.axis_names!r}"
         )
     devices = list(mesh.devices.reshape(-1))
     n = len(devices)
@@ -112,6 +121,28 @@ def plan_submesh(
     )
 
 
+def _plan_submesh_2d(
+    mesh: Mesh, actor_fraction: float, batch_size: int
+) -> SubmeshPlan:
+    """Row split of a (data, mp) grid: whole mp columns move together."""
+    grid = np.asarray(mesh.devices)
+    rows = grid.shape[0]
+    if rows < 2:
+        return shared_plan(mesh)
+    n_actor = max(1, min(rows - 1, round(rows * actor_fraction)))
+    n_actor = largest_divisor(batch_size, n_actor)
+    n_learner = largest_divisor(batch_size, rows - n_actor)
+    actor_grid = grid[:n_actor]
+    learner_grid = grid[n_actor:n_actor + n_learner]
+    return SubmeshPlan(
+        actor=Mesh(actor_grid, mesh.axis_names),
+        learner=Mesh(learner_grid, mesh.axis_names),
+        actor_devices=tuple(actor_grid.reshape(-1)),
+        learner_devices=tuple(learner_grid.reshape(-1)),
+        shared=False,
+    )
+
+
 def shrink_actors(
     plan: SubmeshPlan,
     drop_index: int,
@@ -127,6 +158,12 @@ def shrink_actors(
     """
     if plan.shared or plan.n_actors <= 1:
         return None
+    if len(plan.actor.axis_names) != 1:
+        raise ValueError(
+            "shrink_actors only handles 1-D plans: dropping one device from "
+            "a (data, mp) grid would break the mp columns — shed a whole "
+            "data row by re-planning instead"
+        )
     survivors = list(plan.actor_devices)
     del survivors[drop_index % len(survivors)]
     keep = largest_divisor(batch_size, len(survivors))
@@ -166,6 +203,11 @@ def grow_actors(
     """
     if initial.shared:
         return None
+    if len(initial.actor.axis_names) != 1:
+        raise ValueError(
+            "grow_actors only handles 1-D plans: re-admission into a "
+            "(data, mp) grid re-plans a whole data row instead"
+        )
     if device not in initial.actor_devices:
         raise ValueError(
             f"grow_actors device {device} was never in the initial actor "

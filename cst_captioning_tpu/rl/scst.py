@@ -23,7 +23,6 @@ reward stays debuggable on host, the device work stays fused.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax
@@ -33,7 +32,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cst_captioning_tpu import obs
-from cst_captioning_tpu.compat import pcast, shard_map
+from cst_captioning_tpu.compat import pcast
 from cst_captioning_tpu.config.config import PAD_ID, RLConfig
 from cst_captioning_tpu.decoding import fused_decode, greedy_decode, sample_decode
 from cst_captioning_tpu.decoding.common import _exit_stride, mask_from_tokens
@@ -41,6 +40,7 @@ from cst_captioning_tpu.obs import flops as _flops
 from cst_captioning_tpu.losses import reinforce_loss, sequence_log_probs
 from cst_captioning_tpu.models.captioner import CaptionModel
 from cst_captioning_tpu.parallel.comms import reduce_tree
+from cst_captioning_tpu.parallel.compile import CompilePlan, compile_fn
 from cst_captioning_tpu.resilience import chaos
 from cst_captioning_tpu.resilience.health import collective_span
 from cst_captioning_tpu.resilience.retry import RetryPolicy, retry_call
@@ -127,7 +127,6 @@ def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
     one of the K+1 decoded rows per clip plus its host transfer + reward
     (already one loop — ``fused`` changes nothing there)."""
 
-    @jax.jit
     def decode(params, feats, masks, rng):
         if with_greedy and fused:
             greedy, _, samples, _ = fused_decode(
@@ -147,7 +146,7 @@ def make_rl_decode(model, num_rollouts: int, temperature: float = 1.0,
         )
         return greedy, samples
 
-    return decode
+    return compile_fn(decode, CompilePlan())
 
 
 def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
@@ -193,13 +192,11 @@ def make_parallel_rl_decode(model, mesh: Mesh, num_rollouts: int,
     # over ``batch_axes`` and psum the early-exit row count over it, so the
     # compiler verifies the per-shard/collective split instead of a comment
     # promising the exactness tests will.
-    sharded = shard_map(
-        device_decode,
+    return compile_fn(device_decode, CompilePlan(
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P()),
         out_specs=(P(axis), P(None, axis)),
-    )
-    return jax.jit(sharded)
+    ))
 
 
 def _tile_enc(enc, K):
@@ -414,7 +411,6 @@ def make_rl_update(model, chunks: int = 1, donate: bool = False,
     """
     del comm  # no cross-device reduction on this path
 
-    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def update(state: TrainState, feats, masks, samples, advantage, valid):
         if chunks > 1:
             num, den, g_sum = _chunked_loss_grads(
@@ -444,7 +440,9 @@ def make_rl_update(model, chunks: int = 1, donate: bool = False,
         return _apply(state, grads, loss, gnorm, guard, key="rl_loss",
                       stats=stats)
 
-    return update
+    return compile_fn(
+        update, CompilePlan(donate_argnums=(0,) if donate else ())
+    )
 
 
 def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
@@ -507,13 +505,12 @@ def make_parallel_rl_update(model, mesh: Mesh, axis: str = "data",
         return _apply(state, grads, loss, gnorm, guard, key="rl_loss",
                       stats=stats)
 
-    sharded = shard_map(
-        device_update,
+    return compile_fn(device_update, CompilePlan(
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(None, axis), P(None, axis), P(axis)),
         out_specs=(P(), P()),
-    )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+        donate_argnums=(0,) if donate else (),
+    ))
 
 
 class SCSTTrainer:

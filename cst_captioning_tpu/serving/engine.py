@@ -67,6 +67,7 @@ from cst_captioning_tpu.decoding.common import (
     step_outputs,
 )
 from cst_captioning_tpu.models.captioner import CaptionModel, EncoderOutput
+from cst_captioning_tpu.parallel.compile import CompilePlan, compile_fn
 from cst_captioning_tpu import obs
 from cst_captioning_tpu.obs import anomaly as obs_anomaly
 from cst_captioning_tpu.obs import recorder as obs_recorder
@@ -413,8 +414,8 @@ class CaptionService:
         # seed -> raw key data, jitted: `jax.random.key(seed)` EAGER would
         # stage the seed scalar implicitly (the transfer-guard test's whole
         # point); inside jit the seed arrives as an explicit device_put arg
-        self._key_fn = jax.jit(
-            lambda s: jax.random.key_data(jax.random.key(s))
+        self._key_fn = compile_fn(
+            lambda s: jax.random.key_data(jax.random.key(s)), CompilePlan()
         )
         # SLO burn-rate monitor (SloMonitor docstring): off until a target
         # exists (slo_target_s=0.0 default, or set_slo after calibration)
@@ -955,10 +956,11 @@ class CaptionService:
         fn = self._encode_fns.get((F, npad))
         if fn is None:
             model = self.model
-            fn = jax.jit(
+            fn = compile_fn(
                 lambda p, f, m: model.apply(
                     p, f, m, method=CaptionModel.encode
-                )
+                ),
+                CompilePlan(),
             )
             self._encode_fns[(F, npad)] = fn
         feats, masks = {}, {}
@@ -1024,7 +1026,9 @@ class CaptionService:
 
         L = len(enc_carry)
         assert L == len(carry)
-        self._admit_fn = jax.jit(admit, donate_argnums=(0,))
+        self._admit_fn = compile_fn(
+            admit, CompilePlan(donate_argnums=(0,))
+        )
 
     # ---- the stride ---------------------------------------------------------
 
@@ -1173,7 +1177,7 @@ class CaptionService:
                 lps, inv, axis=2
             )
 
-        return jax.jit(stride, donate_argnums=(7,))
+        return compile_fn(stride, CompilePlan(donate_argnums=(7,)))
 
     def _run_stride(self, report: ServeReport, now) -> None:
         active = sorted(self._inflight)
@@ -1478,11 +1482,12 @@ def static_batch_serve(
     report = ServeReport(submitted=len(pending))
     t0 = clock()
     now = lambda: clock() - t0  # noqa: E731
-    decode = decode_fn or jax.jit(
+    decode = decode_fn or compile_fn(
         lambda p, f, m, r: fused_decode(
             model, p, f, m, r, num_rollouts=num_rollouts,
             temperature=temperature, max_len=T, min_len=min_len,
-        )
+        ),
+        CompilePlan(),
     )
     batch_idx = 0
     service_key = jax.random.key(service_seed)

@@ -1371,8 +1371,10 @@ def scrape_submesh_axes(tree: ast.Module) -> MeshDecl:
 
 
 def scrape_mesh_decl(tree: ast.Module) -> MeshDecl:
-    """Mesh axes (string defaults of ``*axis`` function parameters),
-    PARAM_PARTITION_RULES families, and the SHARDING_CONTRACT path."""
+    """Mesh axes (string defaults of ``*axis`` function parameters), the
+    regex rule families of EVERY ``*PARTITION_RULES`` table (the canonical
+    one plus the flagship-XL per-axis tables, e.g.
+    ``MP_PARAM_PARTITION_RULES``), and the SHARDING_CONTRACT path."""
     axes: set[str] = set(_axis_param_defaults(tree))
     families: list[tuple[str, str]] = []
     contract = ""
@@ -1381,7 +1383,7 @@ def scrape_mesh_decl(tree: ast.Module) -> MeshDecl:
             names = [
                 t.id for t in node.targets if isinstance(t, ast.Name)
             ]
-            if "PARAM_PARTITION_RULES" in names:
+            if any(n.endswith("PARTITION_RULES") for n in names):
                 for elt in getattr(node.value, "elts", []):
                     parts = getattr(elt, "elts", [])
                     if len(parts) >= 2 and isinstance(
@@ -1411,9 +1413,12 @@ CACHE_NAME = ".graftlint_cache.json"
 # dim_vars / dtype_env / pspec_vars / return_dims / return_dtype /
 # returns_host_shape / returns_host_value), and parallel/submesh.py axis
 # declarations are scraped alongside train/mesh.py.
+# v6: the mesh scrape collects families from EVERY *PARTITION_RULES table
+# (flagship-XL adds MP_PARAM_PARTITION_RULES), and the 'mp' axis joins the
+# declared set via make_mesh's ``mp_axis="mp"`` default.
 # A version mismatch discards the cache wholesale — cold start, never a
 # half-read of the old schema.
-_CACHE_VERSION = 5
+_CACHE_VERSION = 6
 _FIXPOINT_MAX_ROUNDS = 25
 
 

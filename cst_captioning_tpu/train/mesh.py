@@ -1,10 +1,17 @@
 """Device mesh + sharding helpers (the NCCL/DataParallel replacement).
 
-One ``Mesh`` axis ``'data'`` for v1 (the reference is pure data-parallel,
-SURVEY.md §2 parallelism table). Axis naming leaves room for a future
-``('dcn', 'data')`` multi-host hierarchy without changing call sites.
+One ``Mesh`` axis ``'data'`` for the data-parallel core (the reference is
+pure data-parallel, SURVEY.md §2 parallelism table), with two optional
+second axes that never coexist:
 
-Batches shard along axis 0 across ``'data'``; params/state replicate.
+- ``('data', 'seq')`` — frame-axis sequence parallelism (long-context);
+- ``('data', 'mp')``  — model parallelism for the flagship-XL configs:
+  vocab/out-projection and LSTM gate matrices shard over ``'mp'`` per
+  :data:`MP_PARAM_PARTITION_RULES`.
+
+Batches shard along axis 0 across ``'data'``; params replicate (DP) or
+follow :func:`match_partition_rules` over the ordered regex rule tables
+(first match wins — the t5x/EasyLM ``match_partition_rules`` idiom).
 ``shard_batch``/``replicate`` place host arrays accordingly so jitted steps
 see committed, correctly-laid-out inputs (no implicit transfers inside the
 step).
@@ -20,12 +27,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def make_mesh(num_devices: int = 0, axis: str = "data",
-              seq_devices: int = 1, seq_axis: str = "seq") -> Mesh:
-    """1-D ``(data,)`` mesh, or 2-D ``(data, seq)`` when ``seq_devices > 1``
-    (the long-context layout: batch over 'data', frames over 'seq')."""
+              seq_devices: int = 1, seq_axis: str = "seq",
+              mp_devices: int = 1, mp_axis: str = "mp") -> Mesh:
+    """1-D ``(data,)`` mesh, 2-D ``(data, seq)`` when ``seq_devices > 1``
+    (the long-context layout: batch over 'data', frames over 'seq'), or
+    2-D ``(data, mp)`` when ``mp_devices > 1`` (the flagship-XL layout:
+    batch over 'data', vocab/gate dims over 'mp'). seq and mp do not
+    compose yet — ExperimentConfig rejects the combination up front."""
     devices = jax.devices()
     if num_devices:
         devices = devices[:num_devices]
+    if seq_devices > 1 and mp_devices > 1:
+        raise ValueError(
+            "seq_devices > 1 and mp_devices > 1 cannot compose yet: the "
+            "collective attention softmax and the sharded-vocab decode "
+            "assume different second axes (pick one)"
+        )
     if seq_devices > 1:
         n = len(devices)
         if n % seq_devices:
@@ -34,6 +51,14 @@ def make_mesh(num_devices: int = 0, axis: str = "data",
             )
         grid = np.asarray(devices).reshape(n // seq_devices, seq_devices)
         return Mesh(grid, (axis, seq_axis))
+    if mp_devices > 1:
+        n = len(devices)
+        if n % mp_devices:
+            raise ValueError(
+                f"mp_devices {mp_devices} must divide the {n} mesh devices"
+            )
+        grid = np.asarray(devices).reshape(n // mp_devices, mp_devices)
+        return Mesh(grid, (axis, mp_axis))
     return Mesh(np.asarray(devices), (axis,))
 
 
@@ -53,15 +78,25 @@ def shard_batch(mesh: Mesh, batch, axis: str = "data"):
 
 # ---- parameter partition contract ------------------------------------------
 #
-# (family, path regex, PartitionSpec) for every parameter family of the
-# caption model. v1 trains pure data-parallel — the reference is DP-only —
-# so every family maps to P() (replicated); the row's value is the CONTRACT,
-# not the spec: ``scripts/check_shardings.py`` dumps the real param tree
-# into SHARDING_CONTRACT, and graftlint rule GL007 cross-checks that every
-# regex still matches at least one parameter and every parameter is covered
-# by some rule. A model refactor that renames a family then fails the
-# linter instead of silently falling out of the (future model-parallel)
-# sharded layout. Order matters: first match wins in param_partition_specs.
+# Ordered (family, path regex, PartitionSpec) tables matched over the
+# flattened param tree — first match wins, like t5x/EasyLM
+# ``match_partition_rules``. Two tables, one contract:
+#
+# - PARAM_PARTITION_RULES: the canonical DP table. Every family maps to P()
+#   (replicated) — the mp=1 degenerate case every default path compiles
+#   against, pinned bit-identical in tests.
+# - MP_PARAM_PARTITION_RULES: the flagship-XL model-parallel table. The
+#   vocab families (word_embed rows, out_proj columns) and the LSTM gate
+#   matrices shard over 'mp'; everything upstream of the gates replicates.
+#
+# The row's value is the CONTRACT, not just the spec:
+# ``scripts/check_shardings.py`` dumps the real param tree into
+# SHARDING_CONTRACT, graftlint rule GL007 cross-checks the canonical table
+# (every regex matches >= 1 parameter, every parameter covered), and GL018
+# extends the same coverage + first-match shadowing check to EVERY
+# *PARTITION_RULES table, this one included. A model refactor that renames
+# a family then fails the linter instead of silently falling out of the
+# sharded layout.
 PARAM_PARTITION_RULES: tuple[tuple[str, str, P], ...] = (
     ("encoder_embed", r"params/encoder/embed_[^/]+/.*", P()),
     ("carry_init", r"params/init_[hc]\d+/.*", P()),
@@ -69,6 +104,22 @@ PARAM_PARTITION_RULES: tuple[tuple[str, str, P], ...] = (
     ("decoder_lstm", r"params/cell/lstm\d+/.*", P()),
     ("word_embed", r"params/cell/word_embed/.*", P()),
     ("output_head", r"params/cell/out_proj/.*", P()),
+)
+
+# flagship-XL: Megatron-style column-parallel vocab projection + row-parallel
+# embedding table, per-gate sharded LSTM kernels. Each gate is its own Dense
+# (kernel [in, H], h-side bias [H]), so sharding the gate output dim needs
+# mp | d_hidden; the vocab families need mp | vocab_size (config-validated).
+MP_PARAM_PARTITION_RULES: tuple[tuple[str, str, P], ...] = (
+    ("encoder_embed", r"params/encoder/embed_[^/]+/.*", P()),
+    ("carry_init", r"params/init_[hc]\d+/.*", P()),
+    ("decoder_attention", r"params/cell/attention/.*", P()),
+    ("decoder_lstm_gate_kernel", r"params/cell/lstm\d+/[ih][ifgo]/kernel",
+     P(None, "mp")),
+    ("decoder_lstm_gate_bias", r"params/cell/lstm\d+/h[ifgo]/bias", P("mp")),
+    ("word_embed", r"params/cell/word_embed/embedding", P("mp")),
+    ("output_head_kernel", r"params/cell/out_proj/kernel", P(None, "mp")),
+    ("output_head_bias", r"params/cell/out_proj/bias", P("mp")),
 )
 
 # repo-root-relative dump of the model param tree the rules above were
@@ -93,11 +144,46 @@ def param_path_names(params) -> list[str]:
     return out
 
 
-def rule_coverage(param_names) -> tuple[list[str], list[str]]:
+def match_rule(rules, name: str) -> tuple[str, P]:
+    """First (family, spec) whose regex fullmatches ``name``.
+
+    Raises ``ValueError`` on an unruled parameter — an unruled param must be
+    an explicit decision (add a family rule), never a silent default.
+    """
+    for family, pattern, spec in rules:
+        if re.fullmatch(pattern, name):
+            return family, spec
+    raise ValueError(
+        f"parameter {name!r} matches no partition rule; add a family rule "
+        "for it (scripts/check_shardings.py verifies coverage)"
+    )
+
+
+def match_partition_rules(rules, params):
+    """PartitionSpec pytree for ``params`` by first-matching ordered regex
+    rule (the t5x/EasyLM ``match_partition_rules`` shape: ``rules`` is an
+    ordered (family, pattern, spec) table, patterns fullmatch the
+    '/'-joined param paths, first match wins, no-match raises)."""
+    names = param_path_names(params)
+    specs = [match_rule(rules, name)[1] for name in names]
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    assert len(flat) == len(specs)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def rule_provenance(rules, param_names) -> dict[str, str]:
+    """param path -> matching family name (the contract dump's provenance
+    column — drift reports name the RULE that claimed each param)."""
+    return {name: match_rule(rules, name)[0] for name in param_names}
+
+
+def rule_coverage(param_names, rules=None) -> tuple[list[str], list[str]]:
     """-> (families matching no param, params matched by no family)."""
+    if rules is None:
+        rules = PARAM_PARTITION_RULES
     unmatched = []
     unruled = set(param_names)
-    for family, pattern, _ in PARAM_PARTITION_RULES:
+    for family, pattern, _ in rules:
         rx = re.compile(pattern)
         hits = [p for p in param_names if rx.fullmatch(p)]
         if not hits:
@@ -106,28 +192,12 @@ def rule_coverage(param_names) -> tuple[list[str], list[str]]:
     return unmatched, sorted(unruled)
 
 
-def param_partition_specs(params):
-    """PartitionSpec pytree for ``params`` by first-matching family rule.
-
-    Raises ``ValueError`` on an unruled parameter — an unruled param must be
-    an explicit decision (add a family rule), never a silent default.
-    """
-    names = param_path_names(params)
-    specs = []
-    for name in names:
-        for _, pattern, spec in PARAM_PARTITION_RULES:
-            if re.fullmatch(pattern, name):
-                specs.append(spec)
-                break
-        else:
-            raise ValueError(
-                f"parameter {name!r} matches no PARAM_PARTITION_RULES entry; "
-                "add a family rule for it (scripts/check_shardings.py "
-                "verifies coverage)"
-            )
-    flat, treedef = jax.tree_util.tree_flatten(params)
-    assert len(flat) == len(specs)
-    return jax.tree_util.tree_unflatten(treedef, specs)
+def param_partition_specs(params, rules=None):
+    """PartitionSpec pytree for ``params`` by first-matching family rule
+    (default: the canonical DP table — the mp=1 degenerate case)."""
+    if rules is None:
+        rules = PARAM_PARTITION_RULES
+    return match_partition_rules(rules, params)
 
 
 def replicate(mesh: Mesh, tree):

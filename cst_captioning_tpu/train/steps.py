@@ -20,7 +20,6 @@ per step and per shard, reproducible under resharding.
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
@@ -28,7 +27,6 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from cst_captioning_tpu.compat import shard_map
 from cst_captioning_tpu.losses import masked_cross_entropy
 from cst_captioning_tpu.resilience.guard import guarded_apply_gradients
 from cst_captioning_tpu.train.state import TrainState
@@ -117,8 +115,9 @@ def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False,
     buffers can't be donation-reused on stats builds.
     """
     del comm  # no cross-device reduction on this path
+    # lazy for the same cycle reason as reduce_tree below
+    from cst_captioning_tpu.parallel.compile import CompilePlan, compile_fn
 
-    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: TrainState, feats, masks, labels, mask, weights):
         drng = jax.random.fold_in(state.rng, state.step)
 
@@ -132,7 +131,9 @@ def make_xe_step(model, label_smoothing: float = 0.0, donate: bool = False,
         gnorm = optax.global_norm(grads)
         return _apply(state, grads, loss, gnorm, guard, stats=stats)
 
-    return step
+    return compile_fn(
+        step, CompilePlan(donate_argnums=(0,) if donate else ())
+    )
 
 
 def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
@@ -152,6 +153,7 @@ def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
     # imported lazily: parallel/__init__ -> seq_parallel imports this module,
     # so a module-level import here would close the cycle mid-initialization
     from cst_captioning_tpu.parallel.comms import reduce_tree
+    from cst_captioning_tpu.parallel.compile import CompilePlan, compile_fn
 
     def device_step(state: TrainState, feats, masks, labels, mask, weights):
         drng = jax.random.fold_in(
@@ -179,13 +181,12 @@ def make_parallel_xe_step(model, mesh: Mesh, label_smoothing: float = 0.0,
         # selects identically on every shard — state stays replicated
         return _apply(state, grads, loss, gnorm, guard, stats=stats)
 
-    sharded = shard_map(
-        device_step,
+    return compile_fn(device_step, CompilePlan(
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P(axis), P(axis), P(axis)),
         out_specs=(P(), P()),
-    )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+        donate_argnums=(0,) if donate else (),
+    ))
 
 
 def batch_arrays(batch) -> tuple[Any, ...]:

@@ -200,7 +200,8 @@ class Trainer:
         sp = cfg.mesh.seq_devices > 1
         self.use_mesh = (n_dev > 1 or sp) if use_mesh is None else use_mesh
         self.mesh = (
-            make_mesh(cfg.mesh.num_devices, seq_devices=cfg.mesh.seq_devices)
+            make_mesh(cfg.mesh.num_devices, seq_devices=cfg.mesh.seq_devices,
+                      mp_devices=cfg.mesh.mp_devices)
             if self.use_mesh else None
         )
         # 2-D ('data','seq') mesh: batch shards over 'data', the FRAME axis

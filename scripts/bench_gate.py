@@ -149,6 +149,8 @@ def check_file(path: str) -> list[str]:
         _check_rl_online(path, data, errors)
     if name == "BENCH_SERVING.json":
         _check_serving(path, data, errors)
+    if name == "BENCH_SCALING.json":
+        _check_scaling(path, data, errors)
     _walk(path, data, errors)
     return errors
 
@@ -267,6 +269,56 @@ def _check_serving(path: str, data: dict, errors: list[str]) -> None:
                 f"dense_footprint_pages = {foot!r} (otherwise the pool "
                 "never held more than one batch's dense-bank worth)"
             )
+
+
+def _check_scaling(path: str, data: dict, errors: list[str]) -> None:
+    """The scaling ledger's own promises beyond the generic schema: the
+    dp weak-scaling points survive (bench_scaling.py merges, never drops),
+    and the flagship-XL ``mp`` block carries an mp>1 rung with the analytic
+    vocab-shard merge bytes, its parity block carries both bit-exact pins
+    (_check_parity then enforces they are true), the embedding-grad
+    dp-allreduce ledger shows the mp-sharded payload strictly below the
+    replicated one, and the CPU-mesh caveat note is present."""
+    if not isinstance(data.get("points"), list) or not data["points"]:
+        errors.append(f"{path}: dp weak-scaling 'points' vanished")
+    mp = data.get("mp")
+    if not isinstance(mp, dict):
+        errors.append(f"{path}: missing the flagship-XL 'mp' block")
+        return
+    rungs = mp.get("rungs")
+    if not isinstance(rungs, list) or not any(
+        isinstance(r, dict) and r.get("mp", 1) > 1 for r in rungs
+    ):
+        errors.append(f"{path}: mp block has no mp>1 rung")
+    else:
+        for r in rungs:
+            if r.get("mp", 1) > 1 and not isinstance(
+                r.get("merge_bytes_per_step_per_device"), dict
+            ):
+                errors.append(
+                    f"{path}: mp={r.get('mp')} rung missing the analytic "
+                    "merge_bytes_per_step_per_device model"
+                )
+    parity = mp.get("parity")
+    if not isinstance(parity, dict):
+        errors.append(f"{path}: mp block missing its parity block")
+    else:
+        for k in ("stride_tokens_bit_exact", "beam_candidates_bit_exact"):
+            if k not in parity:
+                errors.append(f"{path}: mp parity block missing {k!r}")
+    led = mp.get("embedding_grad_ledger")
+    if not isinstance(led, dict) or not (
+        isinstance(led.get("mp1_bytes_on_wire_per_update"), numbers.Real)
+        and isinstance(led.get("mp2_bytes_on_wire_per_update"), numbers.Real)
+        and led["mp2_bytes_on_wire_per_update"]
+        < led["mp1_bytes_on_wire_per_update"]
+    ):
+        errors.append(
+            f"{path}: mp.embedding_grad_ledger must show the mp-sharded "
+            "dp-allreduce strictly below the replicated payload"
+        )
+    if not mp.get("note"):
+        errors.append(f"{path}: mp block missing the CPU-mesh 'note'")
 
 
 def main(argv: list[str]) -> int:

@@ -69,24 +69,43 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     from cst_captioning_tpu.train.mesh import (
+        MP_PARAM_PARTITION_RULES,
         PARAM_PARTITION_RULES,
         SHARDING_CONTRACT,
+        match_rule,
         rule_coverage,
     )
 
     contract_path = args.contract or os.path.join(REPO, SHARDING_CONTRACT)
     names = contract_param_names()
 
+    def provenance() -> dict[str, dict[str, str]]:
+        """Per-param regex-rule provenance: which family claims it in the
+        replicated (dp) table and in the flagship-XL mp table, plus the
+        mp PartitionSpec it lands on."""
+        out: dict[str, dict[str, str]] = {}
+        for name in names:
+            dp_family, _dp_spec = match_rule(PARAM_PARTITION_RULES, name)
+            mp_family, mp_spec = match_rule(MP_PARAM_PARTITION_RULES, name)
+            out[name] = {
+                "dp": dp_family, "mp": mp_family, "mp_spec": str(mp_spec),
+            }
+        return out
+
     if args.write:
         with open(contract_path, "w", encoding="utf-8") as f:
             json.dump({
                 "comment": (
-                    "Param-tree contract for mesh.PARAM_PARTITION_RULES; "
-                    "regenerate with `python scripts/check_shardings.py "
-                    "--write` after model refactors. Verified by this "
-                    "script's default mode and by graftlint GL007."
+                    "Param-tree contract for mesh.PARAM_PARTITION_RULES "
+                    "and MP_PARAM_PARTITION_RULES; regenerate with "
+                    "`python scripts/check_shardings.py --write` after "
+                    "model refactors. Verified by this script's default "
+                    "mode and by graftlint GL007/GL018. 'provenance' maps "
+                    "each param to the rule family that claims it in each "
+                    "table (first match wins) and its mp PartitionSpec."
                 ),
                 "params": names,
+                "provenance": provenance(),
             }, f, indent=2)
             f.write("\n")
         print(f"check_shardings: wrote {len(names)} param path(s) to "
@@ -113,19 +132,37 @@ def main(argv: list[str] | None = None) -> int:
                   "(regenerate with --write; drop its rule if the family "
                   "is gone)", file=sys.stderr)
 
-    unmatched, unruled = rule_coverage(names)
-    for fam in unmatched:
-        ok = False
-        print(f"check_shardings: rule family {fam!r} matches no parameter",
-              file=sys.stderr)
-    for p in unruled:
-        ok = False
-        print(f"check_shardings: parameter {p!r} matches no rule family",
-              file=sys.stderr)
+    for table_name, rules in (
+        ("PARAM_PARTITION_RULES", PARAM_PARTITION_RULES),
+        ("MP_PARAM_PARTITION_RULES", MP_PARAM_PARTITION_RULES),
+    ):
+        unmatched, unruled = rule_coverage(names, rules=rules)
+        for fam in unmatched:
+            ok = False
+            print(f"check_shardings: {table_name} family {fam!r} matches "
+                  "no parameter", file=sys.stderr)
+        for p in unruled:
+            ok = False
+            print(f"check_shardings: parameter {p!r} matches no "
+                  f"{table_name} family", file=sys.stderr)
+
+    recorded_prov = json.load(open(contract_path, encoding="utf-8")).get(
+        "provenance"
+    )
+    if recorded_prov is not None and not added and not removed:
+        live = provenance()
+        for name in names:
+            if recorded_prov.get(name) != live[name]:
+                ok = False
+                print(f"check_shardings: provenance drift for {name!r}: "
+                      f"contract {recorded_prov.get(name)} vs rules "
+                      f"{live[name]} (regenerate with --write)",
+                      file=sys.stderr)
     if ok:
         print(f"check_shardings: OK — {len(names)} params, "
-              f"{len(PARAM_PARTITION_RULES)} families, full coverage both "
-              "ways")
+              f"{len(PARAM_PARTITION_RULES)}+"
+              f"{len(MP_PARAM_PARTITION_RULES)} families, full coverage "
+              "both ways in both tables")
     return 0 if ok else 1
 
 

@@ -35,7 +35,7 @@ python -m cst_captioning_tpu.tools.graftlint \
     cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_comms.py bench_decode.py \
     bench_eval.py bench_recipe.py bench_rl_async.py bench_rl_online.py \
-    bench_serving.py \
+    bench_scaling.py bench_serving.py \
     --fix-check --check-stale --timings --budget 3
 
 # catches syntax errors in files graftlint may not reach (non-.py-suffixed
@@ -43,7 +43,7 @@ python -m cst_captioning_tpu.tools.graftlint \
 python -m compileall -q cst_captioning_tpu tests scripts \
     bench.py bench_attention.py bench_comms.py bench_decode.py \
     bench_eval.py bench_recipe.py bench_rl_async.py bench_rl_online.py \
-    bench_serving.py
+    bench_scaling.py bench_serving.py
 
 # obs_report smoke check: the report CLI must aggregate a known-good run dir
 # without a jax import or backend init (it is part of the operator loop for
@@ -90,6 +90,14 @@ JAX_PLATFORMS=cpu python bench_comms.py --smoke > /dev/null
 # exceed the dense-bank footprint the gather path refuses (fatal on
 # mismatch — README "Serving")
 JAX_PLATFORMS=cpu python bench_serving.py --smoke > /dev/null
+
+# scaling smoke: tiny-dims CPU run of the flagship-XL mp rungs (mp=1
+# replicated stride vs mp=2 vocab-sharded mp_decode_stride + one sharded
+# beam step) with the in-run parity gate inside (tokens and beam
+# candidates bit-exact, logprobs within f32 ulps) — keeps
+# bench_scaling.py and ops/decode_mp.py honest without a TPU in CI
+# (README "Model parallelism (flagship-XL)")
+JAX_PLATFORMS=cpu python bench_scaling.py --smoke > /dev/null
 
 # decoupled-RL smoke: tiny-dims CPU run of the sync/strict/decoupled
 # topology ladder through the real train_epoch, with the strict-parity

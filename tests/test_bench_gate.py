@@ -184,6 +184,107 @@ def test_gate_rejects_stress_hwm_within_dense_footprint(tmp_path):
     assert _run_serving(tmp_path, bad) == 1
 
 
+def _good_scaling() -> dict:
+    """A minimal BENCH_SCALING.json the gate accepts — mirrors the schema
+    bench_scaling.py writes (dp points preserved + the flagship-XL mp
+    block)."""
+    return {
+        "points": [{
+            "metric": "rl_clips_per_sec_per_chip_cpu_mesh",
+            "value": 1.0, "devices": 1,
+        }],
+        "summary": {
+            "metric": "rl_weak_scaling_efficiency",
+            "note": "weak scaling on forced-CPU virtual devices",
+        },
+        "mp": {
+            "metric": "mp_stride_seconds_per_stride_cpu_mesh",
+            "rungs": [
+                {"mp": 1, "seconds_per_stride": 0.004},
+                {"mp": 2, "seconds_per_stride": 0.012,
+                 "merge_bytes_per_step_per_device": {
+                     "emb_psum": 20480, "lse_and_select": 960,
+                     "argmax_all_gather": 1280, "total": 22720,
+                 }},
+            ],
+            "parity": {
+                "stride_tokens_bit_exact": True,
+                "beam_candidates_bit_exact": True,
+                "stride_logprob_max_abs_diff": 4.8e-07,
+            },
+            "embedding_grad_ledger": {
+                "mp1_bytes_on_wire_per_update": 100000,
+                "mp2_bytes_on_wire_per_update": 60000,
+            },
+            "device_kind": "cpu",
+            "note": "mp weak scaling on forced-CPU virtual devices",
+        },
+    }
+
+
+def _run_scaling(tmp_path, data) -> int:
+    (tmp_path / "BENCH_SCALING.json").write_text(json.dumps(data))
+    return bench_gate.main(["bench_gate", str(tmp_path)])
+
+
+def test_gate_accepts_good_scaling_ledger(tmp_path):
+    assert _run_scaling(tmp_path, _good_scaling()) == 0
+
+
+def test_gate_rejects_dropped_dp_points(tmp_path):
+    # bench_scaling.py merges into the committed file — losing the dp
+    # weak-scaling ladder would mean it started overwriting
+    bad = _good_scaling()
+    bad["points"] = []
+    assert _run_scaling(tmp_path, bad) == 1
+
+
+def test_gate_rejects_missing_mp_block(tmp_path):
+    bad = _good_scaling()
+    del bad["mp"]
+    assert _run_scaling(tmp_path, bad) == 1
+
+
+def test_gate_rejects_mp_block_without_sharded_rung(tmp_path):
+    bad = _good_scaling()
+    bad["mp"]["rungs"] = [{"mp": 1, "seconds_per_stride": 0.004}]
+    assert _run_scaling(tmp_path, bad) == 1
+
+
+def test_gate_rejects_mp_rung_without_merge_bytes(tmp_path):
+    bad = _good_scaling()
+    del bad["mp"]["rungs"][1]["merge_bytes_per_step_per_device"]
+    assert _run_scaling(tmp_path, bad) == 1
+
+
+def test_gate_rejects_false_mp_parity(tmp_path):
+    bad = _good_scaling()
+    bad["mp"]["parity"]["stride_tokens_bit_exact"] = False
+    assert _run_scaling(tmp_path, bad) == 1
+
+
+def test_gate_rejects_missing_mp_parity_pin(tmp_path):
+    for pin in ("stride_tokens_bit_exact", "beam_candidates_bit_exact"):
+        bad = _good_scaling()
+        del bad["mp"]["parity"][pin]
+        assert _run_scaling(tmp_path, bad) == 1, pin
+
+
+def test_gate_rejects_mp_ledger_not_below_replicated(tmp_path):
+    # the whole point of the mp dp-allreduce accounting: the sharded
+    # payload must be strictly smaller
+    bad = _good_scaling()
+    bad["mp"]["embedding_grad_ledger"]["mp2_bytes_on_wire_per_update"] = \
+        100000
+    assert _run_scaling(tmp_path, bad) == 1
+
+
+def test_gate_rejects_mp_block_without_note(tmp_path):
+    bad = _good_scaling()
+    bad["mp"]["note"] = ""
+    assert _run_scaling(tmp_path, bad) == 1
+
+
 def test_gate_rejects_nontpu_without_note(tmp_path):
     bad = _good_rl_online()
     bad["note"] = None

@@ -2008,6 +2008,102 @@ def test_gl018_canonical_table_shadowing_only(tmp_path):
     assert all("orphan" not in f.message for f in findings)
 
 
+def test_gl018_covers_mp_table_next_to_canonical(tmp_path):
+    """The flagship-XL layout: MP_PARAM_PARTITION_RULES lives beside the
+    canonical table in the same module. GL018 applies the FULL check there
+    (coverage + shadowing), so a dead mp row and an mp rule matching no
+    contract param are both findings while the canonical twin stays
+    GL007's job."""
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "scripts" / "shardings_contract.json").write_text(
+        json.dumps({"params": ["params/enc/w", "params/dec/w"]})
+    )
+    findings = _lint(tmp_path, "cst_captioning_tpu/train/mesh.py", (
+        "PARAM_PARTITION_RULES = (\n"
+        "    ('enc', r'params/enc/.*', ()),\n"
+        "    ('dec', r'params/dec/.*', ()),\n"
+        ")\n"
+        "MP_PARAM_PARTITION_RULES = (\n"
+        "    ('enc', r'params/enc/.*', ()),\n"
+        "    ('dec', r'params/dec/.*', ()),\n"
+        "    ('dec_dead', r'params/dec/w', ()),\n"     # shadowed by 'dec'
+        "    ('gate_gone', r'params/gate/.*', ()),\n"  # matches nothing
+        ")\n"
+    ), rules=["GL018"])
+    assert _rules_of(findings) == ["GL018"]
+    msgs = {f.line: f for f in findings}
+    assert any("dec_dead" in f.message and "shadowed" in f.message
+               and f.fix is not None for f in findings)
+    assert any("gate_gone" in f.message for f in findings)
+    assert all("MP_PARAM_PARTITION_RULES" in f.message for f in findings)
+    assert len(msgs) == 2
+
+
+def _mp_mesh_fixture(tmp_path):
+    """A fixture train/mesh.py declaring the flagship-XL axes the way the
+    real one does — string defaults of *axis params (the scrape's input)."""
+    (tmp_path / "cst_captioning_tpu" / "train").mkdir(parents=True)
+    (tmp_path / "cst_captioning_tpu" / "train" / "mesh.py").write_text(
+        "def make_mesh(num_devices=0, axis='data', seq_devices=1,\n"
+        "              seq_axis='seq', mp_devices=1, mp_axis='mp'):\n"
+        "    return None\n"
+    )
+
+
+def test_gl015_learns_mp_axis_from_mesh_scrape(tmp_path):
+    """P('data', 'mp') literals lint clean once make_mesh grows the
+    mp_axis='mp' default — no rule-table edit, the axis scrape picks it
+    up; an undeclared axis still fires and the allowed set names 'mp'."""
+    _mp_mesh_fixture(tmp_path)
+    (tmp_path / "cst_captioning_tpu" / "use.py").write_text(
+        "from jax.sharding import PartitionSpec as P\n"
+        "def f():\n"
+        "    return P('data', 'mp'), P(None, 'mp')\n"
+    )
+    assert lint_paths([str(tmp_path)], str(tmp_path), rule_ids=["GL015"],
+                      cache_path="").findings == []
+    (tmp_path / "cst_captioning_tpu" / "use.py").write_text(
+        "from jax.sharding import PartitionSpec as P\n"
+        "def f():\n"
+        "    return P('tp')\n"
+    )
+    findings = lint_paths([str(tmp_path)], str(tmp_path),
+                          rule_ids=["GL015"], cache_path="").findings
+    assert _rules_of(findings) == ["GL015"]
+    assert "'tp'" in findings[0].message and "mp" in findings[0].message
+
+
+def test_gl016_mp_axis_binding_via_shard_map(tmp_path):
+    """A psum over 'mp' is quiet when every reachable caller binds it
+    (shard_map axis_names including 'mp') and a finding from a plain
+    calling context — same fixpoint as 'data'/'seq', new axis."""
+    _mp_mesh_fixture(tmp_path)
+    (tmp_path / "cst_captioning_tpu" / "merge.py").write_text(
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "def merge_lse(x):\n"
+        "    return jax.lax.psum(x, 'mp')\n"
+        "def run(mesh, xs):\n"
+        "    def body(x):\n"
+        "        return merge_lse(x)\n"
+        "    return shard_map(body, mesh=mesh, in_specs=None,\n"
+        "                     out_specs=None, axis_names=('data', 'mp'))(xs)\n"
+    )
+    assert lint_paths([str(tmp_path)], str(tmp_path), rule_ids=["GL016"],
+                      cache_path="").findings == []
+    (tmp_path / "cst_captioning_tpu" / "merge.py").write_text(
+        "import jax\n"
+        "def merge_lse(x):\n"
+        "    return jax.lax.psum(x, 'mp')\n"
+        "def run(xs):\n"
+        "    return [merge_lse(x) for x in xs]\n"
+    )
+    findings = lint_paths([str(tmp_path)], str(tmp_path),
+                          rule_ids=["GL016"], cache_path="").findings
+    assert _rules_of(findings) == ["GL016"]
+    assert "'mp'" in findings[0].message
+
+
 def test_gl018_fix_deletes_dead_rule_and_is_idempotent(tmp_path, capsys):
     """--fix removes the provably-dead shadowed row (whole line, trailing
     comma and all), the tree relints clean, and a second --fix is a
